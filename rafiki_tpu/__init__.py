@@ -1,0 +1,26 @@
+"""rafiki_tpu: a TPU-native distributed AutoML framework.
+
+A ground-up JAX/XLA re-design of the capabilities of Rafiki (reference:
+wanliuhuo/rafiki, a fork of nginyc/rafiki, VLDB 2018 — see SURVEY.md):
+an AutoML service where a Bayesian *advisor* proposes hyperparameter
+("knob") configurations, parallel *train workers* run one trial per TPU
+chip (with optional within-trial data parallelism over ICI), a *meta
+store* persists trials and parameters, and a *predictor* serves the
+top-k trials behind a sharded batched ensemble forward pass.
+
+Layer map (bottom → top), mirroring SURVEY.md §1:
+  store/      — meta store (sqlite3) + params store  [ref: rafiki/db/]
+  model/      — model contract, knobs, datasets, dev harness [ref: rafiki/model/]
+  ops/        — jit'd train/eval/predict step factories (JAX compute path)
+  parallel/   — meshes, data-parallel training, ensemble sharding
+  advisor/    — ask/tell HPO engines (random, GP-EI)  [ref: rafiki/advisor/]
+  worker/     — train + inference workers             [ref: rafiki/worker/]
+  scheduler/  — one-trial-per-chip schedulers         [ref: Docker Swarm + services_manager]
+  bus/        — query/prediction bus                  [ref: rafiki/cache/ (Redis)]
+  predictor/  — ensemble predictor frontend           [ref: rafiki/predictor/]
+  admin/      — control plane + REST                  [ref: rafiki/admin/]
+  client/     — client SDK                            [ref: rafiki/client/]
+  utils/      — auth (JWT), logging, misc
+"""
+
+__version__ = "0.1.0"

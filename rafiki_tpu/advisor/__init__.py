@@ -1,0 +1,17 @@
+"""Ask/tell hyperparameter-optimisation engines.
+
+Reference parity: rafiki/advisor/ (advisor.py + btb_gp_advisor.py /
+skopt variant; unverified paths). The reference exposes
+``propose() -> knobs`` and ``feedback(score, knobs)`` behind either an
+in-proc object or a small HTTP service. Same here: ``BaseAdvisor`` is
+the in-proc engine, ``rafiki_tpu.advisor.service`` wraps it for
+concurrent workers; the GP engine is built on sklearn's Gaussian
+process (skopt is not available in this environment).
+"""
+
+from rafiki_tpu.advisor.base import BaseAdvisor, make_advisor
+from rafiki_tpu.advisor.random_advisor import RandomAdvisor
+from rafiki_tpu.advisor.gp import GpAdvisor
+from rafiki_tpu.advisor.service import AdvisorService
+
+__all__ = ["BaseAdvisor", "RandomAdvisor", "GpAdvisor", "AdvisorService", "make_advisor"]

@@ -1,0 +1,147 @@
+"""Advisor ABC + knob-space vectorisation shared by engines.
+
+The vectorisation (knobs dict ↔ R^d point) lives here so every engine
+(GP, random, future TPE/ENAS) shares one encoding:
+  * FloatKnob(is_exp)   → log-space float dim
+  * IntegerKnob         → float dim, rounded on decode (log if is_exp)
+  * CategoricalKnob     → one float dim in [0, k), floor on decode
+    (GP kernels handle this adequately for the small spaces Rafiki
+    templates declare; matches skopt's Categorical treatment in spirit)
+  * FixedKnob           → excluded from the search space
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from rafiki_tpu.model.knobs import (
+    BaseKnob,
+    CategoricalKnob,
+    FixedKnob,
+    FloatKnob,
+    IntegerKnob,
+    KnobConfig,
+    Knobs,
+)
+
+
+class KnobSpace:
+    """Bidirectional mapping between knob dicts and unit-ish R^d vectors."""
+
+    def __init__(self, knob_config: KnobConfig):
+        self.knob_config = dict(knob_config)
+        self.dims: List[Tuple[str, BaseKnob]] = [
+            (name, k) for name, k in sorted(knob_config.items())
+            if not isinstance(k, FixedKnob)
+        ]
+        self.fixed: Knobs = {
+            name: k.value for name, k in knob_config.items() if isinstance(k, FixedKnob)
+        }
+
+    @property
+    def d(self) -> int:
+        return len(self.dims)
+
+    def bounds(self) -> np.ndarray:
+        """(d, 2) array of [lo, hi] in encoded space."""
+        out = []
+        for _, k in self.dims:
+            if isinstance(k, FloatKnob):
+                lo, hi = ((math.log(k.value_min), math.log(k.value_max))
+                          if k.is_exp else (k.value_min, k.value_max))
+            elif isinstance(k, IntegerKnob):
+                lo, hi = ((math.log(k.value_min), math.log(k.value_max))
+                          if k.is_exp else (k.value_min, k.value_max))
+            elif isinstance(k, CategoricalKnob):
+                lo, hi = 0.0, float(len(k.values)) - 1e-9
+            else:
+                raise TypeError(f"Unsupported knob type {type(k).__name__}")
+            out.append((lo, hi))
+        return np.asarray(out, dtype=np.float64) if out else np.zeros((0, 2))
+
+    def encode(self, knobs: Knobs) -> np.ndarray:
+        v = np.zeros(self.d)
+        for i, (name, k) in enumerate(self.dims):
+            val = knobs[name]
+            if isinstance(k, FloatKnob):
+                v[i] = math.log(val) if k.is_exp else float(val)
+            elif isinstance(k, IntegerKnob):
+                v[i] = math.log(val) if k.is_exp else float(val)
+            elif isinstance(k, CategoricalKnob):
+                v[i] = float(k.values.index(val))
+        return v
+
+    def decode(self, v: np.ndarray) -> Knobs:
+        knobs = dict(self.fixed)
+        b = self.bounds()
+        for i, (name, k) in enumerate(self.dims):
+            x = float(np.clip(v[i], b[i, 0], b[i, 1]))
+            if isinstance(k, FloatKnob):
+                knobs[name] = float(math.exp(x)) if k.is_exp else float(x)
+            elif isinstance(k, IntegerKnob):
+                val = int(round(math.exp(x))) if k.is_exp else int(round(x))
+                knobs[name] = int(np.clip(val, k.value_min, k.value_max))
+            elif isinstance(k, CategoricalKnob):
+                knobs[name] = k.values[int(x)]
+        return knobs
+
+    def sample(self, rng: np.random.Generator) -> Knobs:
+        knobs = dict(self.fixed)
+        for name, k in self.dims:
+            knobs[name] = k.sample(rng)
+        return knobs
+
+
+class BaseAdvisor:
+    """Ask/tell interface (reference: Advisor.propose()/feedback()).
+
+    Thread-safe: the scheduler shares one advisor across all train
+    workers; ask/tell are serialized behind a lock (cheap on CPU —
+    SURVEY.md §7 "advisor fidelity").
+    """
+
+    def __init__(self, knob_config: KnobConfig, seed: int = 0):
+        self.space = KnobSpace(knob_config)
+        self.knob_config = dict(knob_config)
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+        self.history: List[Tuple[Knobs, float]] = []
+
+    def propose(self) -> Knobs:
+        with self._lock:
+            return self._propose()
+
+    def feedback(self, score: float, knobs: Knobs) -> None:
+        with self._lock:
+            self.history.append((dict(knobs), float(score)))
+            self._feedback(float(score), dict(knobs))
+
+    def best(self) -> Optional[Tuple[Knobs, float]]:
+        with self._lock:
+            if not self.history:
+                return None
+            return max(self.history, key=lambda t: t[1])
+
+    # engine hooks (called under the lock)
+    def _propose(self) -> Knobs:
+        raise NotImplementedError
+
+    def _feedback(self, score: float, knobs: Knobs) -> None:
+        pass
+
+
+def make_advisor(knob_config: KnobConfig, kind: str = "gp", seed: int = 0) -> BaseAdvisor:
+    """Factory: 'gp' (default, reference's BTB-GP/skopt analog),
+    'random', or 'grid-free' aliases."""
+    from rafiki_tpu.advisor.gp import GpAdvisor
+    from rafiki_tpu.advisor.random_advisor import RandomAdvisor
+
+    kinds = {"gp": GpAdvisor, "bayesian": GpAdvisor, "btb_gp": GpAdvisor,
+             "skopt": GpAdvisor, "random": RandomAdvisor}
+    if kind not in kinds:
+        raise ValueError(f"Unknown advisor kind {kind!r}; choose from {sorted(kinds)}")
+    return kinds[kind](knob_config, seed=seed)

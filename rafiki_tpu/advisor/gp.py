@@ -1,0 +1,100 @@
+"""Gaussian-process Bayesian optimisation (expected improvement).
+
+Reference parity: rafiki/advisor/btb_gp_advisor.py (BTB ``GP`` tuner)
+and/or the skopt ``Optimizer`` variant (unverified — see SURVEY.md).
+Neither btb nor skopt exists in this environment, so the engine is
+first-party: sklearn ``GaussianProcessRegressor`` (Matérn 5/2 +
+white noise) over the encoded knob space, maximising expected
+improvement over a random candidate set — the same ask/tell semantics
+and proposal quality class as the reference's tuners.
+
+Startup behaviour matches skopt's: the first ``n_initial`` proposals
+are quasi-random exploration; after that, EI argmax.
+"""
+
+from __future__ import annotations
+
+import math
+import warnings
+from typing import List, Optional
+
+import numpy as np
+
+from rafiki_tpu.advisor.base import BaseAdvisor
+from rafiki_tpu.model.knobs import KnobConfig, Knobs
+
+
+class GpAdvisor(BaseAdvisor):
+    def __init__(self, knob_config: KnobConfig, seed: int = 0,
+                 n_initial: int = 8, n_candidates: int = 512, xi: float = 0.01):
+        super().__init__(knob_config, seed=seed)
+        self.n_initial = n_initial
+        self.n_candidates = n_candidates
+        self.xi = xi
+        self._X: List[np.ndarray] = []
+        self._y: List[float] = []
+        self._gp = None
+        self._pending: List[np.ndarray] = []  # proposed, not yet scored
+
+    def _propose(self) -> Knobs:
+        if self.space.d == 0:
+            return dict(self.space.fixed)
+        if len(self._X) < self.n_initial or self._gp is None:
+            knobs = self.space.sample(self._rng)
+            self._pending.append(self.space.encode(knobs))
+            return knobs
+        b = self.space.bounds()
+        cand = self._rng.uniform(b[:, 0], b[:, 1], size=(self.n_candidates, self.space.d))
+        # Refine a slice of candidates around the incumbent (local search)
+        best_x = self._X[int(np.argmax(self._y))]
+        local = best_x[None, :] + self._rng.normal(
+            0.0, 0.1 * (b[:, 1] - b[:, 0]), size=(self.n_candidates // 4, self.space.d))
+        cand = np.clip(np.vstack([cand, local]), b[:, 0], b[:, 1])
+        ei = self._expected_improvement(cand)
+        # Penalise candidates near pending (liar) points so concurrent
+        # workers don't all get the same proposal.
+        for p in self._pending:
+            dist = np.linalg.norm((cand - p) / np.maximum(b[:, 1] - b[:, 0], 1e-12), axis=1)
+            ei = ei * (1.0 - np.exp(-(dist / 0.05) ** 2))
+        x = cand[int(np.argmax(ei))]
+        knobs = self.space.decode(x)
+        # Store the *re-encoded* point: decode rounds integer/categorical
+        # dims, and feedback() removes by encode(knobs) — appending raw x
+        # would leave the pending point stuck forever.
+        self._pending.append(self.space.encode(knobs))
+        return knobs
+
+    def _feedback(self, score: float, knobs: Knobs) -> None:
+        x = self.space.encode(knobs)
+        self._X.append(x)
+        self._y.append(score)
+        self._pending = [p for p in self._pending if not np.allclose(p, x, atol=1e-9)]
+        if len(self._X) >= max(2, min(self.n_initial, 4)):
+            self._fit()
+
+    def _fit(self) -> None:
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import ConstantKernel, Matern, WhiteKernel
+
+        X = np.vstack(self._X)
+        y = np.asarray(self._y)
+        b = self.space.bounds()
+        span = np.maximum(b[:, 1] - b[:, 0], 1e-12)
+        kernel = (ConstantKernel(1.0) * Matern(length_scale=0.25 * span, nu=2.5)
+                  + WhiteKernel(noise_level=1e-4))
+        gp = GaussianProcessRegressor(kernel=kernel, normalize_y=True,
+                                      n_restarts_optimizer=1,
+                                      random_state=int(self._rng.integers(1 << 31)))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            gp.fit(X, y)
+        self._gp = gp
+
+    def _expected_improvement(self, cand: np.ndarray) -> np.ndarray:
+        mu, sigma = self._gp.predict(cand, return_std=True)
+        sigma = np.maximum(sigma, 1e-9)
+        best = max(self._y)
+        z = (mu - best - self.xi) / sigma
+        from scipy.stats import norm
+
+        return (mu - best - self.xi) * norm.cdf(z) + sigma * norm.pdf(z)

@@ -1,0 +1,11 @@
+"""Uniform random search — the baseline engine and the test advisor."""
+
+from __future__ import annotations
+
+from rafiki_tpu.advisor.base import BaseAdvisor
+from rafiki_tpu.model.knobs import Knobs
+
+
+class RandomAdvisor(BaseAdvisor):
+    def _propose(self) -> Knobs:
+        return self.space.sample(self._rng)

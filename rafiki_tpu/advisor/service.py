@@ -1,0 +1,53 @@
+"""Advisor service: a registry of per-(sub)job advisors shared by workers.
+
+Reference parity: rafiki/advisor/app.py (unverified) — a small service
+exposing create/propose/feedback/delete so train workers in other
+processes can share one optimisation state. In-proc workers call this
+object directly; process-per-chip workers reach it over the control
+plane's HTTP (admin app mounts these verbs) or a multiprocessing proxy.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, Optional, Tuple
+
+from rafiki_tpu.advisor.base import BaseAdvisor, make_advisor
+from rafiki_tpu.model.knobs import KnobConfig, Knobs, deserialize_knob_config
+
+
+class AdvisorService:
+    def __init__(self):
+        self._advisors: Dict[str, BaseAdvisor] = {}
+        self._lock = threading.Lock()
+
+    def create_advisor(self, knob_config: KnobConfig | str, kind: str = "gp",
+                       seed: int = 0, advisor_id: Optional[str] = None) -> str:
+        if isinstance(knob_config, str):
+            knob_config = deserialize_knob_config(knob_config)
+        aid = advisor_id or uuid.uuid4().hex
+        with self._lock:
+            if aid not in self._advisors:
+                self._advisors[aid] = make_advisor(knob_config, kind=kind, seed=seed)
+        return aid
+
+    def get(self, advisor_id: str) -> BaseAdvisor:
+        with self._lock:
+            adv = self._advisors.get(advisor_id)
+        if adv is None:
+            raise KeyError(f"No advisor {advisor_id!r}")
+        return adv
+
+    def propose(self, advisor_id: str) -> Knobs:
+        return self.get(advisor_id).propose()
+
+    def feedback(self, advisor_id: str, score: float, knobs: Knobs) -> None:
+        self.get(advisor_id).feedback(score, knobs)
+
+    def best(self, advisor_id: str) -> Optional[Tuple[Knobs, float]]:
+        return self.get(advisor_id).best()
+
+    def delete_advisor(self, advisor_id: str) -> None:
+        with self._lock:
+            self._advisors.pop(advisor_id, None)

@@ -1,0 +1,98 @@
+"""Typed framework configuration with environment-variable overrides.
+
+Reference parity: rafiki/config.py + scripts/.env.sh (unverified paths):
+the reference spreads configuration over env vars injected into
+containers; here one dataclass is the single source of truth and every
+field can be overridden via RAFIKI_TPU_<FIELD>.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+
+
+def _env(name: str, default, cast):
+    raw = os.environ.get(f"RAFIKI_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return cast(raw)
+
+
+@dataclasses.dataclass
+class Config:
+    # Storage
+    data_dir: Path = Path(os.environ.get("RAFIKI_TPU_DATA_DIR", "~/.rafiki_tpu")).expanduser()
+
+    # Control plane
+    admin_host: str = "127.0.0.1"
+    admin_port: int = 3000
+    predictor_port_base: int = 30000
+
+    # Superadmin seed (reference seeds a superadmin on first boot)
+    superadmin_email: str = "superadmin@rafiki"
+    superadmin_password: str = "rafiki"
+
+    # Auth
+    jwt_secret: str = "rafiki-tpu-secret"
+    jwt_ttl_hours: int = 24
+
+    # Scheduling
+    poll_interval_s: float = 0.1
+    trial_heartbeat_s: float = 5.0
+    worker_stale_after_s: float = 60.0
+
+    # Serving
+    predict_timeout_s: float = 10.0
+    inference_batch_size: int = 64
+
+    # Compute
+    default_dtype: str = "bfloat16"
+
+    @property
+    def db_path(self) -> Path:
+        return self.data_dir / "meta.sqlite3"
+
+    @property
+    def params_dir(self) -> Path:
+        return self.data_dir / "params"
+
+    @property
+    def logs_dir(self) -> Path:
+        return self.data_dir / "logs"
+
+    @property
+    def datasets_dir(self) -> Path:
+        return self.data_dir / "datasets"
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        cfg = cls()
+        for f in dataclasses.fields(cls):
+            cur = getattr(cfg, f.name)
+            cast = type(cur) if not isinstance(cur, Path) else (lambda s: Path(s).expanduser())
+            setattr(cfg, f.name, _env(f.name, cur, cast))
+        return cfg
+
+    def ensure_dirs(self) -> "Config":
+        for d in (self.data_dir, self.params_dir, self.logs_dir, self.datasets_dir):
+            Path(d).mkdir(parents=True, exist_ok=True)
+        return self
+
+
+_default: Config | None = None
+
+
+def get_config() -> Config:
+    global _default
+    if _default is None:
+        _default = Config.from_env()
+    return _default
+
+
+def set_config(cfg: Config) -> None:
+    global _default
+    _default = cfg

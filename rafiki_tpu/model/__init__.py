@@ -1,0 +1,44 @@
+"""Model contract + knob system + dataset utilities + dev harness.
+
+Reference parity: rafiki/model/ (model.py, knob.py, dataset.py, log.py;
+unverified paths — see SURVEY.md). This is the pure-library layer model
+developers code against; it has no dependency on the control plane.
+"""
+
+from rafiki_tpu.model.knobs import (
+    BaseKnob,
+    CategoricalKnob,
+    FixedKnob,
+    FloatKnob,
+    IntegerKnob,
+    deserialize_knob_config,
+    knob_config_signature,
+    serialize_knob_config,
+    validate_knobs,
+)
+from rafiki_tpu.model.base import BaseModel, JaxModel, load_model_class, parse_model_install_command
+from rafiki_tpu.model.dataset import Dataset, dataset_utils
+from rafiki_tpu.model.log import ModelLogger, logger
+from rafiki_tpu.model.dev import test_model_class, tune_model
+
+__all__ = [
+    "BaseKnob",
+    "FixedKnob",
+    "CategoricalKnob",
+    "IntegerKnob",
+    "FloatKnob",
+    "serialize_knob_config",
+    "deserialize_knob_config",
+    "knob_config_signature",
+    "validate_knobs",
+    "BaseModel",
+    "JaxModel",
+    "load_model_class",
+    "parse_model_install_command",
+    "Dataset",
+    "dataset_utils",
+    "ModelLogger",
+    "logger",
+    "test_model_class",
+    "tune_model",
+]

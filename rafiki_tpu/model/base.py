@@ -1,0 +1,298 @@
+"""The model contract: what a model template must implement.
+
+Reference parity: rafiki/model/model.py (unverified path — see
+SURVEY.md). The reference's ``BaseModel`` hooks are
+``get_knob_config() / init(knobs) / train(dataset_uri) /
+evaluate(dataset_uri) -> float / predict(queries) -> list /
+dump_parameters() / load_parameters() / destroy()``; uploaded model
+``.py`` files are loaded with ``load_model_class``.
+
+We keep the same surface (so reference model templates translate
+mechanically) and add a TPU-native base class, ``JaxModel``, that model
+developers subclass instead of hand-writing device loops: they provide a
+flax Module + knob config, and train/evaluate/predict become jit'd XLA
+programs with optional within-trial data parallelism over a device mesh.
+"""
+
+from __future__ import annotations
+
+import abc
+import importlib
+import importlib.util
+import io
+import pickle
+import sys
+import tempfile
+import types
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from rafiki_tpu.model.knobs import KnobConfig, Knobs, validate_knobs
+from rafiki_tpu.model.dataset import Dataset, dataset_utils
+
+
+class BaseModel(abc.ABC):
+    """Abstract model template (reference-compatible surface).
+
+    Lifecycle of one trial (driven by the train worker, SURVEY.md §3.1):
+      model = ModelClass(**knobs)      # reference: init(knobs)
+      model.train(train_uri)
+      score = model.evaluate(val_uri)
+      blob = model.dump_parameters()
+      ... later, for serving ...
+      model = ModelClass(**knobs); model.load_parameters(blob)
+      out = model.predict(queries)
+    """
+
+    def __init__(self, **knobs: Any):
+        self.knobs: Knobs = validate_knobs(self.get_knob_config(), knobs)
+
+    # -- static declarations -------------------------------------------------
+
+    @staticmethod
+    @abc.abstractmethod
+    def get_knob_config() -> KnobConfig:
+        """Declare the hyperparameter space."""
+
+    # -- trial hooks ---------------------------------------------------------
+
+    @abc.abstractmethod
+    def train(self, dataset_uri: str) -> None: ...
+
+    @abc.abstractmethod
+    def evaluate(self, dataset_uri: str) -> float: ...
+
+    @abc.abstractmethod
+    def predict(self, queries: List[Any]) -> List[Any]: ...
+
+    def dump_parameters(self) -> bytes:
+        raise NotImplementedError
+
+    def load_parameters(self, blob: bytes) -> None:
+        raise NotImplementedError
+
+    def destroy(self) -> None:
+        """Release device/host resources (optional)."""
+
+    # -- conveniences --------------------------------------------------------
+
+    @classmethod
+    def knob_config(cls) -> KnobConfig:
+        return cls.get_knob_config()
+
+
+class JaxModel(BaseModel):
+    """TPU-native base: subclass provides a flax Module, gets jit'd hooks.
+
+    Subclasses implement:
+      * ``get_knob_config()`` — include the conventional knobs
+        ``learning_rate`` / ``batch_size`` / ``epochs`` (or override
+        the corresponding properties);
+      * ``build_module(num_classes, input_shape) -> flax.linen.Module``
+        whose ``__call__(x, train: bool)`` returns logits.
+
+    Optional overrides: ``make_optimizer()``, ``loss()``,
+    ``preprocess(x)``.
+
+    The mesh used for within-trial data parallelism is injected by the
+    scheduler via ``set_mesh`` before ``train`` (SURVEY.md §7 step 7);
+    by default the model runs on the process's default device.
+    """
+
+    def __init__(self, **knobs: Any):
+        super().__init__(**knobs)
+        self._loop = None  # ops.train.TrainLoop, built lazily at train/load
+        self._mesh = None
+        self._seed = int(self.knobs.get("seed", 0))
+        self._dataset_meta: Dict[str, Any] = {}
+
+    # -- knob conventions ----------------------------------------------------
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.knobs.get("batch_size", 64))
+
+    @property
+    def epochs(self) -> int:
+        return int(self.knobs.get("epochs", 1))
+
+    @property
+    def learning_rate(self) -> float:
+        return float(self.knobs.get("learning_rate", 1e-3))
+
+    # -- subclass surface ----------------------------------------------------
+
+    @abc.abstractmethod
+    def build_module(self, num_classes: int, input_shape: tuple):
+        """Return a flax.linen.Module mapping x -> logits."""
+
+    def make_optimizer(self):
+        import optax
+
+        return optax.adam(self.learning_rate)
+
+    def preprocess(self, x: np.ndarray) -> np.ndarray:
+        return x
+
+    def loss(self, params, batch, rng, apply_fn):
+        from rafiki_tpu.ops.train import cross_entropy_loss
+
+        logits = apply_fn(params, batch, train=True, rng=rng)
+        loss, acc = cross_entropy_loss(logits, batch["y"])
+        return loss, {"acc": acc}
+
+    # -- internal wiring -----------------------------------------------------
+
+    def set_mesh(self, mesh) -> None:
+        self._mesh = mesh
+
+    def _build_loop(self, num_classes: int, input_shape: tuple):
+        import jax
+        from rafiki_tpu.ops.train import TrainLoop
+
+        module = self.build_module(num_classes, input_shape)
+
+        def apply_train(params, batch, train=False, rng=None):
+            kwargs = {}
+            if rng is not None:
+                kwargs["rngs"] = {"dropout": rng}
+            return module.apply({"params": params}, batch["x"], train=train, **kwargs)
+
+        def apply_eval(params, batch):
+            return apply_train(params, batch, train=False)
+
+        def init_fn(rng):
+            dummy = np.zeros((1,) + tuple(input_shape), self._input_dtype())
+            variables = module.init(rng, dummy, train=False)
+            return variables["params"]
+
+        def loss_fn(params, batch, rng):
+            return self.loss(params, batch, rng, apply_train)
+
+        self._module = module
+        self._loop = TrainLoop(init_fn, apply_eval, loss_fn, self.make_optimizer(),
+                               mesh=self._mesh, seed=self._seed)
+        self._arch = (num_classes, tuple(input_shape))
+
+    def _input_dtype(self):
+        return np.float32
+
+    def _dataset_arch(self, ds: Dataset) -> tuple:
+        return ds.classes, tuple(ds.x.shape[1:])
+
+    # -- contract hooks ------------------------------------------------------
+
+    def train(self, dataset_uri: str) -> None:
+        from rafiki_tpu.model.log import logger
+
+        ds = dataset_utils.load(dataset_uri)
+        ds = Dataset(self.preprocess(ds.x), ds.y, ds.classes, ds.mask, ds.meta)
+        self._dataset_meta = dict(ds.meta)
+        num_classes, input_shape = self._dataset_arch(ds)
+        if self._loop is None:
+            self._build_loop(num_classes, input_shape)
+        elif self._arch != (num_classes, input_shape):
+            raise ValueError(
+                f"Dataset architecture {(num_classes, input_shape)} does not match "
+                f"the loaded model {self._arch}; use a fresh model instance")
+        logger.define_plot("Training", ["loss", "acc"], x_axis="epoch")
+        for epoch in range(self.epochs):
+            metrics = self._loop.run_epoch(ds, self.batch_size, epoch_seed=self._seed + epoch)
+            logger.log(epoch=epoch, **metrics)
+
+    def evaluate(self, dataset_uri: str) -> float:
+        if self._loop is None:
+            raise RuntimeError("Model has no parameters: call train() or load_parameters() first")
+        ds = dataset_utils.load(dataset_uri)
+        ds = Dataset(self.preprocess(ds.x), ds.y, ds.classes, ds.mask, ds.meta)
+        return float(self._loop.evaluate(ds, self.batch_size))
+
+    def predict(self, queries: List[Any]) -> List[List[float]]:
+        if self._loop is None:
+            raise RuntimeError("Model has no parameters: call train() or load_parameters() first")
+        x = self.preprocess(np.asarray(queries, dtype=self._input_dtype()))
+        probs = self._loop.predict_proba(x, self.batch_size)
+        return probs.tolist()
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        """Array-in/array-out fast path used by the ensemble predictor."""
+        if self._loop is None:
+            raise RuntimeError("Model has no parameters: call train() or load_parameters() first")
+        return self._loop.predict_proba(self.preprocess(np.asarray(x, self._input_dtype())),
+                                        self.batch_size)
+
+    # -- params --------------------------------------------------------------
+
+    def dump_parameters(self) -> bytes:
+        import jax
+        from flax import serialization
+
+        if self._loop is None:
+            raise RuntimeError("No parameters to dump: model not trained/loaded")
+        params = jax.device_get(self._loop.params)
+        payload = {
+            "arch": self._arch,
+            "params": serialization.to_bytes(params),
+            "dataset_meta": {k: v for k, v in self._dataset_meta.items()
+                              if isinstance(v, (str, int, float, bool))},
+        }
+        return pickle.dumps(payload)
+
+    def load_parameters(self, blob: bytes) -> None:
+        from flax import serialization
+
+        payload = pickle.loads(blob)
+        num_classes, input_shape = payload["arch"]
+        self._dataset_meta = payload.get("dataset_meta", {})
+        self._build_loop(num_classes, tuple(input_shape))
+        template = self._loop.params
+        params = serialization.from_bytes(template, payload["params"])
+        import jax
+
+        self._loop.params = jax.device_put(params)
+
+    def destroy(self) -> None:
+        self._loop = None
+
+
+# ---------------------------------------------------------------------------
+# Model file loading (reference: load_model_class executes uploaded .py)
+# ---------------------------------------------------------------------------
+
+def load_model_class(model_file_bytes: bytes, model_class: str,
+                     temp_mod_name: Optional[str] = None) -> type:
+    """Load a model template class from uploaded ``.py`` source bytes.
+
+    Matches the reference behavior of exec-ing the uploaded file into a
+    scratch module. The uploaded source is *trusted* (model developers
+    are authenticated users — same trust model as the reference).
+    """
+    name = temp_mod_name or f"_rafiki_model_{abs(hash(model_file_bytes)) % (1 << 30):x}"
+    mod = types.ModuleType(name)
+    mod.__dict__["__file__"] = f"<{name}.py>"
+    sys.modules[name] = mod
+    try:
+        exec(compile(model_file_bytes, f"<{name}.py>", "exec"), mod.__dict__)
+    except Exception:
+        del sys.modules[name]
+        raise
+    if not hasattr(mod, model_class):
+        del sys.modules[name]
+        raise ValueError(f"Model file defines no class named {model_class!r}")
+    cls = getattr(mod, model_class)
+    if not (isinstance(cls, type) and issubclass(cls, BaseModel)):
+        del sys.modules[name]
+        raise ValueError(f"{model_class} must subclass rafiki_tpu BaseModel")
+    return cls
+
+
+def parse_model_install_command(dependencies: Dict[str, str]) -> List[str]:
+    """Validate a model's declared deps are importable (no pip in this
+    environment; the reference instead generated a pip install command)."""
+    missing = []
+    for dep in dependencies or {}:
+        pkg = {"scikit-learn": "sklearn", "Pillow": "PIL"}.get(dep, dep.replace("-", "_"))
+        if importlib.util.find_spec(pkg) is None:
+            missing.append(dep)
+    return missing

@@ -1,0 +1,299 @@
+"""Dataset utilities: URI-addressed datasets, device-ready batching.
+
+Reference parity: rafiki/model/dataset.py (unverified path):
+``dataset_utils.load_dataset_of_image_files(uri)`` (zip of image files +
+``images.csv`` with class labels) and ``load_dataset_of_corpus(uri)``
+(zip of a TSV corpus for POS tagging). Datasets are addressed by URI.
+
+TPU-native design:
+  * a loaded ``Dataset`` is dense numpy arrays (NHWC uint8 images /
+    int32 token-tag matrices), so the training loop feeds the device
+    fixed-shape batches — XLA traces once per (batch, shape) signature.
+  * ``batches()`` drops the train remainder (static shapes for jit) and
+    pads + masks the eval remainder, so evaluation is exact without
+    dynamic shapes.
+  * ``synthetic://`` URIs generate deterministic learnable datasets
+    in-process (class-conditional Gaussian images; token-tag sequences
+    with a learnable token→tag mapping). This environment has zero
+    network egress, and it also gives tests/benches a data source with
+    real learnable signal.
+
+URI schemes:
+  synthetic://images?classes=10&w=28&h=28&c=1&n=2048&seed=0
+  synthetic://corpus?vocab=200&tags=10&n=512&len=24&seed=0
+  /path/to/dataset.zip        (zip of images + images.csv, reference format)
+  /path/to/dataset.npz        (npz with arrays x, y)
+  file:///path/to/dataset.zip
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import os
+import urllib.parse
+import zipfile
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class Dataset:
+    """An in-memory dataset of (x, y) numpy arrays.
+
+    For images: x is (N, H, W, C) float32 in [0, 1], y is (N,) int32.
+    For corpora: x is (N, L) int32 token ids, y is (N, L) int32 tag ids
+    with -1 padding, plus ``mask`` (N, L) bool.
+    """
+
+    x: np.ndarray
+    y: np.ndarray
+    classes: int
+    mask: Optional[np.ndarray] = None
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def size(self) -> int:
+        return int(self.x.shape[0])
+
+    def split(self, frac: float, seed: int = 0) -> Tuple["Dataset", "Dataset"]:
+        """Deterministic shuffled split into (first, second) with |first| = frac*N."""
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(self.size)
+        k = int(self.size * frac)
+        a, b = order[:k], order[k:]
+        mk = lambda idx: Dataset(
+            self.x[idx], self.y[idx], self.classes,
+            None if self.mask is None else self.mask[idx], dict(self.meta),
+        )
+        return mk(a), mk(b)
+
+    def batches(
+        self,
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_remainder: bool = True,
+    ) -> Iterator[dict]:
+        """Yield dicts of fixed-shape numpy batches.
+
+        drop_remainder=True  → training mode: every batch is exactly
+            batch_size (static shape → single XLA program).
+        drop_remainder=False → eval mode: the last batch is zero-padded
+            to batch_size and carries ``valid`` (bool mask over rows) so
+            metrics can ignore padding.
+        """
+        n = self.size
+        order = np.random.default_rng(seed).permutation(n) if shuffle else np.arange(n)
+        for start in range(0, n, batch_size):
+            idx = order[start : start + batch_size]
+            if len(idx) < batch_size:
+                if drop_remainder:
+                    return
+                pad = batch_size - len(idx)
+                idx = np.concatenate([idx, np.zeros(pad, dtype=idx.dtype)])
+                valid = np.zeros(batch_size, dtype=bool)
+                valid[: batch_size - pad] = True
+            else:
+                valid = np.ones(batch_size, dtype=bool)
+            batch = {"x": self.x[idx], "y": self.y[idx], "valid": valid}
+            if self.mask is not None:
+                batch["mask"] = self.mask[idx]
+            yield batch
+
+
+# ---------------------------------------------------------------------------
+# Synthetic generators (deterministic, learnable)
+# ---------------------------------------------------------------------------
+
+def synthetic_images(classes=10, w=28, h=28, c=1, n=2048, seed=0, noise=0.35,
+                     dist=0) -> Dataset:
+    """Class-conditional Gaussian-blob images.
+
+    Each class k gets a fixed random template image; samples are
+    template + Gaussian noise, clipped to [0, 1]. Linearly separable
+    enough that accuracy tracks model/knob quality (the property the
+    advisor needs), hard enough that more training helps.
+
+    ``dist`` seeds the class templates (the underlying distribution);
+    ``seed`` seeds the draws. Train/test splits of the same task share
+    ``dist`` and differ in ``seed`` — otherwise they would be different
+    classification problems and generalization would be impossible.
+    """
+    templates = (np.random.default_rng(dist)
+                 .uniform(0.0, 1.0, size=(classes, h, w, c)).astype(np.float32))
+    rng = np.random.default_rng(seed + 1_000_003)
+    y = rng.integers(0, classes, size=n).astype(np.int32)
+    x = templates[y] + rng.normal(0.0, noise, size=(n, h, w, c)).astype(np.float32)
+    x = np.clip(x, 0.0, 1.0).astype(np.float32)
+    return Dataset(x, y, classes, meta={"kind": "images", "synthetic": True})
+
+
+def synthetic_corpus(vocab=200, tags=10, n=512, length=24, seed=0, noise=0.05,
+                     dist=0) -> Dataset:
+    """Token sequences with a fixed random token→tag mapping (+ noise).
+
+    A model that learns the per-token mapping (as an HMM/BiLSTM will)
+    reaches ~(1-noise) accuracy. ``dist`` seeds the token→tag mapping,
+    ``seed`` the draws (see synthetic_images on why they are separate).
+    """
+    tok2tag = (np.random.default_rng(dist)
+               .integers(0, tags, size=vocab).astype(np.int32))
+    rng = np.random.default_rng(seed + 1_000_003)
+    x = rng.integers(1, vocab, size=(n, length)).astype(np.int32)  # 0 = pad
+    y = tok2tag[x]
+    flip = rng.uniform(size=y.shape) < noise
+    y = np.where(flip, rng.integers(0, tags, size=y.shape), y).astype(np.int32)
+    lens = rng.integers(max(2, length // 2), length + 1, size=n)
+    mask = np.arange(length)[None, :] < lens[:, None]
+    x = np.where(mask, x, 0).astype(np.int32)
+    y = np.where(mask, y, -1).astype(np.int32)
+    return Dataset(x, y, tags, mask=mask, meta={"kind": "corpus", "synthetic": True, "vocab": vocab})
+
+
+# ---------------------------------------------------------------------------
+# Reference on-disk formats
+# ---------------------------------------------------------------------------
+
+def load_dataset_of_image_files(uri: str) -> Dataset:
+    """Load the reference's image-zip format.
+
+    Format (ref: rafiki/model/dataset.py, unverified): a zip containing
+    image files plus ``images.csv`` with header ``path,class``; images
+    are loaded, converted to grayscale-or-RGB arrays scaled to [0, 1].
+    """
+    path = _resolve_path(uri)
+    if path.endswith(".npz"):
+        return _load_npz(path, kind="images")
+    from PIL import Image
+
+    xs: List[np.ndarray] = []
+    ys: List[int] = []
+    with zipfile.ZipFile(path) as zf:
+        with zf.open("images.csv") as f:
+            rows = list(csv.DictReader(io.TextIOWrapper(f, "utf-8")))
+        for row in rows:
+            with zf.open(row["path"]) as imf:
+                img = Image.open(imf)
+                arr = np.asarray(img, dtype=np.float32) / 255.0
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+            xs.append(arr)
+            ys.append(int(row["class"]))
+    x = np.stack(xs)
+    y = np.asarray(ys, dtype=np.int32)
+    return Dataset(x, y, classes=int(y.max()) + 1, meta={"kind": "images", "uri": uri})
+
+
+def load_dataset_of_corpus(uri: str, tag_col: str = "tag") -> Dataset:
+    """Load the reference's corpus-zip format: a TSV ``corpus.tsv`` of
+    token/tag rows with blank lines between sentences."""
+    path = _resolve_path(uri)
+    if path.endswith(".npz"):
+        return _load_npz(path, kind="corpus")
+    sents: List[List[Tuple[str, str]]] = []
+    with zipfile.ZipFile(path) as zf:
+        name = next(n for n in zf.namelist() if n.endswith(".tsv"))
+        with zf.open(name) as f:
+            cur: List[Tuple[str, str]] = []
+            for line in io.TextIOWrapper(f, "utf-8"):
+                line = line.rstrip("\n")
+                if not line:
+                    if cur:
+                        sents.append(cur)
+                        cur = []
+                    continue
+                tok, tag = line.split("\t")[:2]
+                cur.append((tok, tag))
+            if cur:
+                sents.append(cur)
+    vocab: Dict[str, int] = {"<pad>": 0}
+    tagset: Dict[str, int] = {}
+    for s in sents:
+        for tok, tag in s:
+            vocab.setdefault(tok, len(vocab))
+            tagset.setdefault(tag, len(tagset))
+    length = max(len(s) for s in sents)
+    n = len(sents)
+    x = np.zeros((n, length), dtype=np.int32)
+    y = np.full((n, length), -1, dtype=np.int32)
+    mask = np.zeros((n, length), dtype=bool)
+    for i, s in enumerate(sents):
+        for j, (tok, tag) in enumerate(s):
+            x[i, j] = vocab[tok]
+            y[i, j] = tagset[tag]
+            mask[i, j] = True
+    return Dataset(x, y, classes=len(tagset), mask=mask,
+                   meta={"kind": "corpus", "uri": uri, "vocab": len(vocab),
+                         "vocab_map": vocab, "tag_map": tagset})
+
+
+def _load_npz(path: str, kind: str) -> Dataset:
+    with np.load(path, allow_pickle=False) as z:
+        x = z["x"]
+        y = z["y"].astype(np.int32)
+        mask = z["mask"] if "mask" in z else None
+    classes = int(y.max()) + 1 if kind == "images" else int(y[y >= 0].max()) + 1
+    if kind == "images" and x.dtype == np.uint8:
+        x = x.astype(np.float32) / 255.0
+    meta = {"kind": kind, "uri": path}
+    if kind == "corpus":
+        meta["vocab"] = int(x.max()) + 1
+    return Dataset(x, y, classes=classes, mask=mask, meta=meta)
+
+
+def _resolve_path(uri: str) -> str:
+    if uri.startswith("file://"):
+        return urllib.parse.urlparse(uri).path
+    return os.path.expanduser(uri)
+
+
+# ---------------------------------------------------------------------------
+# Front door
+# ---------------------------------------------------------------------------
+
+class DatasetUtils:
+    """URI front door, mirroring the reference's ``dataset_utils`` object."""
+
+    def load(self, uri: str) -> Dataset:
+        if uri.startswith("synthetic://"):
+            parsed = urllib.parse.urlparse(uri)
+            q = {k: int(v[0]) if v[0].lstrip("-").isdigit() else float(v[0])
+                 for k, v in urllib.parse.parse_qs(parsed.query).items()}
+            if parsed.netloc == "images":
+                return synthetic_images(**{k: q[k] for k in q if k in
+                                           ("classes", "w", "h", "c", "n", "seed", "noise", "dist")})
+            if parsed.netloc == "corpus":
+                kw = dict(q)
+                if "len" in kw:
+                    kw["length"] = kw.pop("len")
+                return synthetic_corpus(**{k: kw[k] for k in kw if k in
+                                           ("vocab", "tags", "n", "length", "seed", "noise", "dist")})
+            raise ValueError(f"Unknown synthetic dataset: {parsed.netloc!r}")
+        path = _resolve_path(uri)
+        if path.endswith(".npz"):
+            with np.load(path, allow_pickle=False) as z:
+                kind = "corpus" if ("mask" in z or z["x"].ndim == 2) else "images"
+            return _load_npz(path, kind)
+        # zip: sniff for corpus vs images
+        with zipfile.ZipFile(path) as zf:
+            names = zf.namelist()
+        if any(n.endswith(".tsv") for n in names):
+            return load_dataset_of_corpus(uri)
+        return load_dataset_of_image_files(uri)
+
+    load_dataset_of_image_files = staticmethod(load_dataset_of_image_files)
+    load_dataset_of_corpus = staticmethod(load_dataset_of_corpus)
+
+    @staticmethod
+    def save_npz(dataset: Dataset, path: str) -> str:
+        arrays = {"x": dataset.x, "y": dataset.y}
+        if dataset.mask is not None:
+            arrays["mask"] = dataset.mask
+        np.savez_compressed(path, **arrays)
+        return path
+
+
+dataset_utils = DatasetUtils()

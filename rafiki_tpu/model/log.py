@@ -1,0 +1,76 @@
+"""Trial-time model logger.
+
+Reference parity: rafiki/model/log.py (unverified path): models call
+``logger.log(...)`` / ``logger.define_plot(...)`` / ``logger.log(epoch=,
+loss=)`` during train(); the train worker captures entries and persists
+them as TrialLog rows retrievable via the client and plotted in the UI.
+
+Here the logger is a context-swappable collector: the worker installs a
+sink around each trial; outside a trial, entries go to stdout logging.
+Entries are JSONL-friendly dicts ``{"time": ..., "type": "message"|
+"values"|"plot", ...}``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_py_logger = logging.getLogger("rafiki_tpu.model")
+
+LogEntry = Dict[str, Any]
+Sink = Callable[[LogEntry], None]
+
+
+class ModelLogger:
+    """The ``logger`` object importable by model templates."""
+
+    def __init__(self):
+        self._local = threading.local()
+
+    def _sink(self) -> Optional[Sink]:
+        return getattr(self._local, "sink", None)
+
+    def _emit(self, entry: LogEntry) -> None:
+        entry.setdefault("time", time.time())
+        sink = self._sink()
+        if sink is not None:
+            sink(entry)
+        else:
+            _py_logger.info("%s", entry)
+
+    # -- API used by model templates (reference-compatible) -----------------
+
+    def log(self, msg: str = "", **values) -> None:
+        """``logger.log("message")`` or ``logger.log(epoch=3, loss=0.1)``."""
+        if msg:
+            self._emit({"type": "message", "message": str(msg)})
+        if values:
+            self._emit({"type": "values", "values": values})
+
+    def define_plot(self, title: str, metrics: List[str], x_axis: Optional[str] = None) -> None:
+        self._emit({"type": "plot", "title": title, "metrics": list(metrics), "x_axis": x_axis})
+
+    def define_loss_plot(self) -> None:
+        self.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+
+    def log_loss(self, loss: float, epoch: int) -> None:
+        self.log(loss=float(loss), epoch=int(epoch))
+
+    # -- API used by the worker ---------------------------------------------
+
+    @contextlib.contextmanager
+    def capture(self, sink: Sink):
+        """Route this thread's log entries into ``sink`` for the duration."""
+        prev = self._sink()
+        self._local.sink = sink
+        try:
+            yield
+        finally:
+            self._local.sink = prev
+
+
+logger = ModelLogger()

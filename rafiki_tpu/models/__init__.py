@@ -1,0 +1,42 @@
+"""Model zoo: TPU-native model templates mirroring the reference's
+examples/models/ (SURVEY.md §2 "Example models", unverified paths):
+
+  FeedForward  ← TfFeedForward.py  (MLP, MNIST-class images)
+  Vgg          ← TfVgg16.py        (VGG CNN, CIFAR-10-class images)
+  DenseNet     ← PyDenseNet.py     (DenseNet-BC CNN, CIFAR-10)
+  SkDt / SkSvm ← SkDt.py, SkSvm.py (sklearn host models)
+  PosBiLstm    ← PyBiLstm.py       (BiLSTM POS tagger)
+  PosBigramHmm ← BigramHmm.py      (bigram HMM POS tagger)
+"""
+
+from rafiki_tpu.models.ff import FeedForward
+
+__all__ = ["FeedForward"]
+
+
+def _optional():
+    # Heavier templates are imported lazily by the registry below.
+    pass
+
+
+MODEL_REGISTRY = {
+    "FeedForward": ("rafiki_tpu.models.ff", "FeedForward"),
+    "Vgg": ("rafiki_tpu.models.vgg", "Vgg"),
+    "DenseNet": ("rafiki_tpu.models.densenet", "DenseNet"),
+    "SkDt": ("rafiki_tpu.models.sk", "SkDt"),
+    "SkSvm": ("rafiki_tpu.models.sk", "SkSvm"),
+    "PosBiLstm": ("rafiki_tpu.models.pos_bilstm", "PosBiLstm"),
+    "PosBigramHmm": ("rafiki_tpu.models.pos_hmm", "PosBigramHmm"),
+}
+
+
+def get_model_class(name: str) -> type:
+    import importlib
+
+    if name not in MODEL_REGISTRY:
+        raise ValueError(f"Unknown model template {name!r}; known: {sorted(MODEL_REGISTRY)}")
+    mod_name, cls_name = MODEL_REGISTRY[name]
+    try:
+        return getattr(importlib.import_module(mod_name), cls_name)
+    except ModuleNotFoundError as e:
+        raise ValueError(f"Model template {name!r} is not available: {e}") from e

@@ -1,0 +1,23 @@
+"""JAX/XLA compute path: jit'd step factories, losses, metrics.
+
+This layer replaces the reference's delegation to TF1/PyTorch CUDA
+kernels (SURVEY.md §2 language note) with first-party JAX programs:
+everything that touches the device goes through here or through
+``rafiki_tpu.parallel``.
+"""
+
+from rafiki_tpu.ops.train import (
+    TrainLoop,
+    cross_entropy_loss,
+    make_eval_step,
+    make_predict_fn,
+    make_train_step,
+)
+
+__all__ = [
+    "TrainLoop",
+    "cross_entropy_loss",
+    "make_train_step",
+    "make_eval_step",
+    "make_predict_fn",
+]

@@ -1,0 +1,231 @@
+"""Generic jit'd training machinery shared by all JAX model templates.
+
+Reference contrast: in Rafiki the inner epoch/step loop lives inside
+each model template's ``train()`` (TF session.run / torch .backward(),
+100% of GPU time — SURVEY.md §3.1). Here the loop is first-party and
+TPU-shaped:
+
+  * one compiled XLA program per (knob-signature, batch-shape); the
+    step is ``jax.jit`` with donated carry state, so params/opt-state
+    stay resident in HBM and the host only ships input batches;
+  * optional within-trial data parallelism: pass a ``Mesh`` and batches
+    are sharded over the ``"dp"`` axis while state is replicated — XLA
+    inserts the gradient all-reduce (psum over ICI) automatically from
+    the sharding annotations (no hand-written collectives needed);
+  * compute dtype is bfloat16 by default (MXU-native), parameters and
+    the optimizer state stay float32.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Batch = Dict[str, np.ndarray]
+Params = Any
+LossFn = Callable[[Params, Dict[str, jnp.ndarray], jax.Array], Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]]
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray,
+                       valid: Optional[jnp.ndarray] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Masked softmax cross entropy + accuracy.
+
+    logits: (..., C) float; labels: (...) int32, -1 = ignore;
+    valid: optional (...) bool combined with the label mask.
+    Returns (mean loss, mean accuracy) over unmasked elements.
+    """
+    mask = labels >= 0
+    if valid is not None:
+        mask = jnp.logical_and(mask, valid)
+    labels_safe = jnp.where(mask, labels, 0)
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels_safe[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(mask.sum(), 1)
+    loss = jnp.where(mask, nll, 0.0).sum() / denom
+    correct = (jnp.argmax(logits, axis=-1) == labels_safe) & mask
+    acc = correct.sum() / denom
+    return loss, acc
+
+
+@dataclass
+class _ShardingPlan:
+    """Shardings for (state, batch) on an optional dp mesh."""
+
+    mesh: Optional[Mesh]
+    state_sharding: Optional[NamedSharding]
+    batch_sharding: Optional[NamedSharding]
+
+    @classmethod
+    def build(cls, mesh: Optional[Mesh]) -> "_ShardingPlan":
+        if mesh is None:
+            return cls(None, None, None)
+        return cls(
+            mesh=mesh,
+            state_sharding=NamedSharding(mesh, P()),           # replicated
+            batch_sharding=NamedSharding(mesh, P("dp")),        # batch-sharded
+        )
+
+    def put_batch(self, batch: Batch) -> Dict[str, jax.Array]:
+        if self.batch_sharding is None:
+            return {k: jnp.asarray(v) for k, v in batch.items()}
+        return {k: jax.device_put(v, self.batch_sharding) for k, v in batch.items()}
+
+    def put_state(self, state):
+        if self.state_sharding is None:
+            return state
+        return jax.device_put(state, self.state_sharding)
+
+
+def make_train_step(loss_fn: LossFn, optimizer: optax.GradientTransformation,
+                    plan: _ShardingPlan):
+    """Build the donated, jit'd SGD step.
+
+    state = (params, opt_state, step, rng). The whole carry is donated:
+    XLA reuses the HBM buffers in place, so per-step host traffic is
+    just the input batch.
+    """
+
+    def step(state, batch):
+        params, opt_state, step_i, rng = state
+        rng, sub = jax.random.split(rng)
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch, sub)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = dict(metrics, loss=loss)
+        return (params, opt_state, step_i + 1, rng), metrics
+
+    kwargs = {}
+    if plan.mesh is not None:
+        # Shardings are pytree-prefixes: replicate all of state, shard all of batch.
+        kwargs = dict(
+            in_shardings=(plan.state_sharding, plan.batch_sharding),
+            out_shardings=(plan.state_sharding, plan.state_sharding),
+        )
+    return jax.jit(step, donate_argnums=(0,), **kwargs)
+
+
+def make_eval_step(apply_fn, plan: _ShardingPlan):
+    """Jit'd eval step returning (#correct, #valid) so the host can sum."""
+
+    def step(params, batch):
+        logits = apply_fn(params, batch)
+        labels = batch["y"]
+        mask = labels >= 0
+        if "valid" in batch:
+            v = batch["valid"]
+            mask = jnp.logical_and(mask, v.reshape(v.shape + (1,) * (mask.ndim - v.ndim)))
+        labels_safe = jnp.where(mask, labels, 0)
+        correct = (jnp.argmax(logits, axis=-1) == labels_safe) & mask
+        return correct.sum(), mask.sum()
+
+    kwargs = {}
+    if plan.mesh is not None:
+        kwargs = dict(in_shardings=(plan.state_sharding, plan.batch_sharding))
+    return jax.jit(step, **kwargs)
+
+
+def make_predict_fn(apply_fn, plan: _ShardingPlan):
+    """Jit'd forward returning probabilities."""
+
+    def fwd(params, batch):
+        logits = apply_fn(params, batch)
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    kwargs = {}
+    if plan.mesh is not None:
+        kwargs = dict(in_shardings=(plan.state_sharding, plan.batch_sharding))
+    return jax.jit(fwd, **kwargs)
+
+
+class TrainLoop:
+    """Drives epochs of jit'd steps over a Dataset for one trial.
+
+    Parameters
+    ----------
+    init_fn: rng -> params
+    apply_fn: (params, batch) -> logits
+    loss_fn: (params, batch, rng) -> (loss, metrics dict)
+    optimizer: optax transform
+    mesh: optional dp Mesh (within-trial data parallelism). With a mesh
+        of k devices the global batch is sharded k ways; gradients are
+        all-reduced over ICI by XLA (from sharding annotations).
+    """
+
+    def __init__(self, init_fn, apply_fn, loss_fn, optimizer,
+                 mesh: Optional[Mesh] = None, seed: int = 0):
+        self.plan = _ShardingPlan.build(mesh)
+        self.apply_fn = apply_fn
+        self.optimizer = optimizer
+        self._train_step = make_train_step(loss_fn, optimizer, self.plan)
+        self._eval_step = make_eval_step(apply_fn, self.plan)
+        self._predict = make_predict_fn(apply_fn, self.plan)
+        rng = jax.random.PRNGKey(seed)
+        rng, init_rng = jax.random.split(rng)
+        params = init_fn(init_rng)
+        opt_state = optimizer.init(params)
+        self.state = self.plan.put_state((params, opt_state, jnp.zeros((), jnp.int32), rng))
+
+    @property
+    def params(self):
+        return self.state[0]
+
+    @params.setter
+    def params(self, params):
+        _, opt_state, step, rng = self.state
+        self.state = (self.plan.put_state(params), opt_state, step, rng)
+
+    def run_epoch(self, dataset, batch_size: int, epoch_seed: int,
+                  on_metrics: Optional[Callable[[int, Dict[str, float]], None]] = None) -> Dict[str, float]:
+        if dataset.size < batch_size:
+            raise ValueError(
+                f"Dataset has {dataset.size} examples < batch_size={batch_size}; "
+                f"the epoch would run zero steps")
+        last = {}
+        count = 0
+        for i, batch in enumerate(dataset.batches(batch_size, shuffle=True, seed=epoch_seed,
+                                                  drop_remainder=True)):
+            batch.pop("valid", None)
+            dev_batch = self.plan.put_batch(batch)
+            self.state, metrics = self._train_step(self.state, dev_batch)
+            count += 1
+            if on_metrics is not None and (i % 50 == 0):
+                m = {k: float(v) for k, v in metrics.items()}
+                on_metrics(i, m)
+                last = m
+        if count and not last:
+            last = {k: float(v) for k, v in metrics.items()}
+        return last
+
+    def evaluate(self, dataset, batch_size: int) -> float:
+        total_correct = 0
+        total = 0
+        for batch in dataset.batches(batch_size, shuffle=False, drop_remainder=False):
+            dev_batch = self.plan.put_batch(batch)
+            c, n = self._eval_step(self.state[0], dev_batch)
+            total_correct += int(c)
+            total += int(n)
+        return total_correct / max(total, 1)
+
+    def predict_proba(self, x: np.ndarray, batch_size: int, extra: Optional[Batch] = None) -> np.ndarray:
+        """Forward a query array; pads to full batches, returns (N, ..., C) probs."""
+        n = x.shape[0]
+        outs = []
+        for start in range(0, n, batch_size):
+            chunk = x[start : start + batch_size]
+            pad = batch_size - chunk.shape[0]
+            if pad:
+                chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, axis=0)])
+            batch = {"x": chunk}
+            if extra:
+                batch.update(extra)
+            probs = np.asarray(self._predict(self.state[0], self.plan.put_batch(batch)))
+            outs.append(probs[: batch_size - pad] if pad else probs)
+        return np.concatenate(outs) if outs else np.zeros((0,))

@@ -1,0 +1,35 @@
+"""Test harness: fake an 8-chip pod on CPU.
+
+Set platform/device-count flags BEFORE jax initialises (SURVEY.md §7
+"faking the pod in CI"). Every test then sees 8 jax CPU devices, so
+schedulers, meshes and collectives are exercised without TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# This image's sitecustomize force-registers a TPU PJRT plugin backend
+# regardless of JAX_PLATFORMS; the explicit config update wins.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_config(tmp_path):
+    """A Config rooted in a temp dir, installed as the process default."""
+    from rafiki_tpu.config import Config, set_config, get_config
+
+    cfg = Config(data_dir=tmp_path / "rafiki")
+    cfg.ensure_dirs()
+    prev = get_config()
+    set_config(cfg)
+    yield cfg
+    set_config(prev)

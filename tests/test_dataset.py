@@ -1,0 +1,72 @@
+import numpy as np
+
+from rafiki_tpu.model.dataset import Dataset, dataset_utils, synthetic_corpus, synthetic_images
+
+
+def test_synthetic_images_learnable_and_deterministic():
+    a = dataset_utils.load("synthetic://images?classes=5&n=256&seed=3")
+    b = dataset_utils.load("synthetic://images?classes=5&n=256&seed=3")
+    assert a.size == 256 and a.classes == 5
+    np.testing.assert_array_equal(a.x, b.x)
+    assert a.x.min() >= 0.0 and a.x.max() <= 1.0
+
+
+def test_train_batches_static_shape():
+    ds = synthetic_images(n=150, seed=0)
+    batches = list(ds.batches(64, shuffle=True, seed=1, drop_remainder=True))
+    assert len(batches) == 2
+    assert all(b["x"].shape[0] == 64 for b in batches)
+
+
+def test_eval_batches_padded_and_masked():
+    ds = synthetic_images(n=150, seed=0)
+    batches = list(ds.batches(64, drop_remainder=False))
+    assert len(batches) == 3
+    assert batches[-1]["x"].shape[0] == 64
+    assert batches[-1]["valid"].sum() == 150 - 128
+    total_valid = sum(b["valid"].sum() for b in batches)
+    assert total_valid == 150
+
+
+def test_split_is_disjoint_and_total():
+    ds = synthetic_images(n=100, seed=0)
+    a, b = ds.split(0.8, seed=1)
+    assert a.size == 80 and b.size == 20
+
+
+def test_corpus_masks_and_labels():
+    ds = synthetic_corpus(vocab=50, tags=5, n=32, length=12, seed=0)
+    assert ds.x.shape == (32, 12)
+    assert ds.mask is not None
+    assert (ds.y[~ds.mask] == -1).all()
+    assert (ds.y[ds.mask] >= 0).all()
+
+
+def test_image_zip_format_round_trip(tmp_path):
+    import zipfile
+    from PIL import Image
+
+    zpath = tmp_path / "ds.zip"
+    rng = np.random.default_rng(0)
+    with zipfile.ZipFile(zpath, "w") as zf:
+        rows = ["path,class"]
+        for i in range(6):
+            arr = (rng.uniform(0, 255, size=(8, 8)).astype(np.uint8))
+            import io
+
+            buf = io.BytesIO()
+            Image.fromarray(arr, mode="L").save(buf, format="PNG")
+            zf.writestr(f"img_{i}.png", buf.getvalue())
+            rows.append(f"img_{i}.png,{i % 3}")
+        zf.writestr("images.csv", "\n".join(rows))
+    ds = dataset_utils.load(str(zpath))
+    assert ds.size == 6 and ds.classes == 3
+    assert ds.x.shape == (6, 8, 8, 1)
+
+
+def test_npz_round_trip(tmp_path):
+    ds = synthetic_images(n=32, seed=0)
+    path = dataset_utils.save_npz(ds, str(tmp_path / "d.npz"))
+    ds2 = dataset_utils.load(path)
+    assert ds2.size == 32
+    np.testing.assert_allclose(ds.x, ds2.x, atol=1e-6)

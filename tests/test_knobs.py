@@ -1,0 +1,75 @@
+import numpy as np
+import pytest
+
+from rafiki_tpu.model.knobs import (
+    CategoricalKnob,
+    FixedKnob,
+    FloatKnob,
+    IntegerKnob,
+    deserialize_knob_config,
+    knob_config_signature,
+    sample_knobs,
+    serialize_knob_config,
+    validate_knobs,
+)
+
+
+def _config():
+    return {
+        "layers": IntegerKnob(1, 3, affects_shape=True),
+        "units": CategoricalKnob([32, 64], affects_shape=True),
+        "lr": FloatKnob(1e-4, 1e-1, is_exp=True),
+        "epochs": FixedKnob(2),
+    }
+
+
+def test_serialization_round_trip():
+    cfg = _config()
+    s = serialize_knob_config(cfg)
+    cfg2 = deserialize_knob_config(s)
+    assert cfg == cfg2
+
+
+def test_sampling_respects_bounds():
+    rng = np.random.default_rng(0)
+    cfg = _config()
+    for _ in range(200):
+        knobs = sample_knobs(cfg, rng)
+        validate_knobs(cfg, knobs)
+        assert 1 <= knobs["layers"] <= 3
+        assert knobs["units"] in (32, 64)
+        assert 1e-4 <= knobs["lr"] <= 1e-1
+        assert knobs["epochs"] == 2
+
+
+def test_log_scale_sampling_covers_decades():
+    rng = np.random.default_rng(0)
+    k = FloatKnob(1e-4, 1e-1, is_exp=True)
+    vals = [k.sample(rng) for _ in range(500)]
+    assert sum(v < 1e-3 for v in vals) > 50  # log-uniform, not uniform
+    assert sum(v > 1e-2 for v in vals) > 50
+
+
+def test_validate_rejects_bad_values():
+    cfg = _config()
+    with pytest.raises(ValueError):
+        validate_knobs(cfg, {"layers": 7, "units": 32, "lr": 1e-3})
+    with pytest.raises(ValueError):
+        validate_knobs(cfg, {"layers": 2, "units": 48, "lr": 1e-3})
+    with pytest.raises(ValueError):
+        validate_knobs(cfg, {"layers": 2, "units": 32, "lr": 1e-3, "bogus": 1})
+
+
+def test_fixed_knob_filled_in():
+    cfg = _config()
+    knobs = validate_knobs(cfg, {"layers": 2, "units": 32, "lr": 1e-3})
+    assert knobs["epochs"] == 2
+
+
+def test_shape_signature_groups_static_knobs():
+    cfg = _config()
+    a = {"layers": 2, "units": 32, "lr": 1e-3, "epochs": 2}
+    b = {"layers": 2, "units": 32, "lr": 5e-2, "epochs": 2}  # only lr differs
+    c = {"layers": 3, "units": 32, "lr": 1e-3, "epochs": 2}
+    assert knob_config_signature(cfg, a) == knob_config_signature(cfg, b)
+    assert knob_config_signature(cfg, a) != knob_config_signature(cfg, c)

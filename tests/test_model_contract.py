@@ -1,0 +1,78 @@
+"""The minimum end-to-end slice (SURVEY.md §7): FeedForward on synthetic
+MNIST-class data through the full trial loop, on the CPU backend."""
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.model.dataset import synthetic_images
+from rafiki_tpu.model.dev import test_model_class, tune_model
+from rafiki_tpu.models.ff import FeedForward
+
+TRAIN = "synthetic://images?classes=10&n=1024&seed=0"
+TEST = "synthetic://images?classes=10&n=256&seed=1"
+
+FAST_KNOBS = dict(hidden_layers=1, hidden_units=64, learning_rate=3e-3,
+                  batch_size=64, epochs=2, seed=0)
+
+
+def test_full_trial_loop_learns():
+    queries = [synthetic_images(n=4, seed=2).x[i] for i in range(4)]
+    score, preds = test_model_class(
+        FeedForward, "IMAGE_CLASSIFICATION", TRAIN, TEST,
+        queries=queries, knobs=FAST_KNOBS)
+    assert score > 0.5  # learnable synthetic data; random = 0.1
+    assert len(preds) == 4
+    assert len(preds[0]) == 10
+    np.testing.assert_allclose(np.sum(preds, axis=1), 1.0, atol=1e-3)
+
+
+def test_params_round_trip_bytes():
+    m = FeedForward(**FAST_KNOBS)
+    m.train(TRAIN)
+    blob = m.dump_parameters()
+    assert isinstance(blob, bytes) and len(blob) > 1000
+    m2 = FeedForward(**FAST_KNOBS)
+    m2.load_parameters(blob)
+    q = synthetic_images(n=8, seed=3).x
+    np.testing.assert_allclose(m.predict_proba(q), m2.predict_proba(q), atol=1e-5)
+
+
+def test_load_model_class_from_source():
+    from rafiki_tpu.model.base import load_model_class
+
+    src = b"""
+from rafiki_tpu.model.base import JaxModel
+from rafiki_tpu.model.knobs import FloatKnob, FixedKnob
+from rafiki_tpu.models.ff import _Mlp
+
+class MyModel(JaxModel):
+    @staticmethod
+    def get_knob_config():
+        return {"learning_rate": FloatKnob(1e-4, 1e-1, is_exp=True),
+                "epochs": FixedKnob(1)}
+
+    def build_module(self, num_classes, input_shape):
+        return _Mlp(hidden_layers=1, hidden_units=16, num_classes=num_classes)
+"""
+    cls = load_model_class(src, "MyModel")
+    assert cls.__name__ == "MyModel"
+    m = cls(learning_rate=1e-3)
+    m.train("synthetic://images?classes=3&n=128&seed=0")
+    assert 0.0 <= m.evaluate("synthetic://images?classes=3&n=64&seed=1") <= 1.0
+
+
+def test_load_model_class_rejects_bad():
+    from rafiki_tpu.model.base import load_model_class
+
+    with pytest.raises(ValueError):
+        load_model_class(b"x = 1", "MyModel")
+    with pytest.raises(ValueError):
+        load_model_class(b"class MyModel: pass", "MyModel")
+
+
+def test_tune_model_random_advisor():
+    best_knobs, best_score, records = tune_model(
+        FeedForward, TRAIN, TEST, total_trials=3, advisor="random", seed=0)
+    assert len(records) == 3
+    assert best_score == max(r["score"] for r in records)
+    assert best_score > 0.3
