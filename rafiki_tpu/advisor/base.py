@@ -81,7 +81,9 @@ class KnobSpace:
         for i, (name, k) in enumerate(self.dims):
             x = float(np.clip(v[i], b[i, 0], b[i, 1]))
             if isinstance(k, FloatKnob):
-                knobs[name] = float(math.exp(x)) if k.is_exp else float(x)
+                val = float(math.exp(x)) if k.is_exp else float(x)
+                # exp(log(max)) can overshoot max by 1 ulp → clamp
+                knobs[name] = min(max(val, k.value_min), k.value_max)
             elif isinstance(k, IntegerKnob):
                 val = int(round(math.exp(x))) if k.is_exp else int(round(x))
                 knobs[name] = int(np.clip(val, k.value_min, k.value_max))

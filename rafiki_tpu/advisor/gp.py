@@ -60,8 +60,12 @@ class GpAdvisor(BaseAdvisor):
         knobs = self.space.decode(x)
         # Store the *re-encoded* point: decode rounds integer/categorical
         # dims, and feedback() removes by encode(knobs) — appending raw x
-        # would leave the pending point stuck forever.
+        # would leave the pending point stuck forever. Cap the list so a
+        # worker that dies before feedback() can't suppress a region
+        # forever (oldest liars expire first).
         self._pending.append(self.space.encode(knobs))
+        if len(self._pending) > 16:
+            self._pending.pop(0)
         return knobs
 
     def _feedback(self, score: float, knobs: Knobs) -> None:

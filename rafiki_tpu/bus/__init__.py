@@ -1,0 +1,15 @@
+"""Query/prediction bus between the predictor frontend and inference
+workers.
+
+Reference parity: rafiki/cache/cache.py (unverified) — a Redis wrapper
+with per-worker query queues, per-query prediction slots, and a
+running-worker registry. Redis is not needed for a one-host TPU
+topology: the in-proc bus is plain queues + dict; the multiprocessing
+variant shares the same interface over a Manager, so predictor and
+workers can live in separate processes (the reference's deployment
+shape) without an external service.
+"""
+
+from rafiki_tpu.bus.queues import InProcBus, make_mp_bus
+
+__all__ = ["InProcBus", "make_mp_bus"]

@@ -1,0 +1,173 @@
+"""The bus: per-worker query queues + per-query prediction slots.
+
+Interface (mirrors the reference's Cache verbs, SURVEY.md §2):
+  add_worker(job_id, worker_id)          — register a live worker
+  get_workers(job_id)                    — running-worker set
+  remove_worker(job_id, worker_id)
+  add_query(worker_id, query_id, query)  — predictor → worker fan-out
+  pop_queries(worker_id, max_n, timeout) — worker batch pull
+  put_prediction(query_id, worker_id, prediction)
+  get_predictions(query_id, n, timeout)  — predictor gather-wait
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class InProcBus:
+    def __init__(self):
+        self._queues: Dict[str, queue.Queue] = defaultdict(queue.Queue)
+        self._preds: Dict[str, list] = {}
+        self._pred_cv = threading.Condition()
+        self._workers: Dict[str, set] = defaultdict(set)
+        self._lock = threading.Lock()
+
+    # -- worker registry -----------------------------------------------------
+
+    def add_worker(self, job_id: str, worker_id: str) -> None:
+        with self._lock:
+            self._workers[job_id].add(worker_id)
+
+    def remove_worker(self, job_id: str, worker_id: str) -> None:
+        with self._lock:
+            self._workers[job_id].discard(worker_id)
+
+    def get_workers(self, job_id: str) -> List[str]:
+        with self._lock:
+            return sorted(self._workers[job_id])
+
+    # -- queries -------------------------------------------------------------
+
+    def add_query(self, worker_id: str, query_id: str, query: Any) -> None:
+        self._queues[worker_id].put((query_id, query))
+
+    def pop_queries(self, worker_id: str, max_n: int = 64,
+                    timeout: float = 0.1) -> List[Tuple[str, Any]]:
+        """Block up to ``timeout`` for the first query, then drain up to
+        max_n without blocking — natural micro-batching for the device."""
+        q = self._queues[worker_id]
+        out: List[Tuple[str, Any]] = []
+        try:
+            out.append(q.get(timeout=timeout))
+        except queue.Empty:
+            return out
+        while len(out) < max_n:
+            try:
+                out.append(q.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+    # -- predictions ---------------------------------------------------------
+
+    def put_prediction(self, query_id: str, worker_id: str, prediction: Any) -> None:
+        with self._pred_cv:
+            self._preds.setdefault(query_id, []).append((worker_id, prediction))
+            self._pred_cv.notify_all()
+
+    def get_predictions(self, query_id: str, n: int,
+                        timeout: float = 10.0) -> List[Tuple[str, Any]]:
+        """Wait until n predictions arrived (or timeout); pops the slot."""
+        deadline = time.monotonic() + timeout
+        with self._pred_cv:
+            while len(self._preds.get(query_id, [])) < n:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._pred_cv.wait(remaining)
+            return self._preds.pop(query_id, [])
+
+
+def make_mp_bus(manager=None):
+    """A multiprocessing-shared bus with the same interface.
+
+    Built on a ``multiprocessing.Manager`` so predictor and inference
+    workers can run as separate processes on the TPU host — the
+    deployment shape the reference achieves with Redis.
+    """
+    import multiprocessing as mp
+
+    # spawn, not fork: JAX is multithreaded and fork() can deadlock.
+    manager = manager or mp.get_context("spawn").Manager()
+    return _MpBus(manager)
+
+
+class _MpBus:
+    def __init__(self, manager):
+        self._manager = manager
+        self._queues = manager.dict()   # worker_id -> manager.Queue
+        self._preds = manager.dict()    # query_id -> manager.list
+        self._workers = manager.dict()  # job_id -> manager.list
+        self._lock = manager.Lock()
+
+    def _q(self, worker_id: str):
+        with self._lock:
+            q = self._queues.get(worker_id)
+            if q is None:
+                q = self._manager.Queue()
+                self._queues[worker_id] = q
+        return q
+
+    def add_worker(self, job_id, worker_id):
+        with self._lock:
+            ws = self._workers.get(job_id)
+            if ws is None:
+                ws = self._manager.list()
+                self._workers[job_id] = ws
+            if worker_id not in list(ws):
+                ws.append(worker_id)
+
+    def remove_worker(self, job_id, worker_id):
+        with self._lock:
+            ws = self._workers.get(job_id)
+            if ws is not None and worker_id in list(ws):
+                ws.remove(worker_id)
+
+    def get_workers(self, job_id):
+        ws = self._workers.get(job_id)
+        return sorted(list(ws)) if ws is not None else []
+
+    def add_query(self, worker_id, query_id, query):
+        self._q(worker_id).put((query_id, query))
+
+    def pop_queries(self, worker_id, max_n=64, timeout=0.1):
+        import queue as q_mod
+
+        q = self._q(worker_id)
+        out = []
+        try:
+            out.append(q.get(timeout=timeout))
+        except q_mod.Empty:
+            return out
+        while len(out) < max_n:
+            try:
+                out.append(q.get_nowait())
+            except q_mod.Empty:
+                break
+        return out
+
+    def put_prediction(self, query_id, worker_id, prediction):
+        with self._lock:
+            preds = self._preds.get(query_id)
+            if preds is None:
+                preds = self._manager.list()
+                self._preds[query_id] = preds
+            preds.append((worker_id, prediction))
+
+    def get_predictions(self, query_id, n, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while True:
+            preds = self._preds.get(query_id)
+            if preds is not None and len(preds) >= n:
+                break
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        with self._lock:
+            preds = self._preds.pop(query_id, None)
+        return list(preds) if preds is not None else []

@@ -83,16 +83,18 @@ def tune_model(model_class: type, train_dataset_uri: str, test_dataset_uri: str,
     records: List[Dict] = []
     for i in range(total_trials):
         knobs = adv.propose()
-        model = model_class(**knobs)
         t0 = time.monotonic()
+        model = None
         try:
+            model = model_class(**knobs)
             model.train(train_dataset_uri)
             score = float(model.evaluate(test_dataset_uri))
             status = "COMPLETED"
         except Exception as e:  # containment: a bad knob config must not kill the loop
             score, status = 0.0, f"ERRORED: {e}"
         finally:
-            model.destroy()
+            if model is not None:
+                model.destroy()
         adv.feedback(score, knobs)
         records.append({"no": i, "knobs": knobs, "score": score,
                         "time_s": time.monotonic() - t0, "status": status})

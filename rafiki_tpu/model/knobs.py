@@ -141,7 +141,8 @@ class IntegerKnob(BaseKnob):
 class FloatKnob(BaseKnob):
     """Float dimension; ``is_exp`` samples log-uniformly (e.g. learning rates)."""
 
-    def __init__(self, value_min: float, value_max: float, is_exp: bool = False):
+    def __init__(self, value_min: float, value_max: float, is_exp: bool = False,
+                 affects_shape: bool = False):
         if value_min > value_max:
             raise ValueError("value_min > value_max")
         if is_exp and value_min <= 0:
@@ -149,6 +150,7 @@ class FloatKnob(BaseKnob):
         self.value_min = float(value_min)
         self.value_max = float(value_max)
         self.is_exp = is_exp
+        self.affects_shape = affects_shape
 
     def validate(self, value):
         if not isinstance(value, (int, float)) or isinstance(value, bool):
@@ -168,11 +170,13 @@ class FloatKnob(BaseKnob):
             "value_min": self.value_min,
             "value_max": self.value_max,
             "is_exp": self.is_exp,
+            "affects_shape": self.affects_shape,
         }
 
     @classmethod
     def _from_json(cls, obj):
-        return cls(obj["value_min"], obj["value_max"], obj.get("is_exp", False))
+        return cls(obj["value_min"], obj["value_max"], obj.get("is_exp", False),
+                   obj.get("affects_shape", False))
 
 
 _KNOB_TYPES = {

@@ -188,8 +188,8 @@ class TrainLoop:
             raise ValueError(
                 f"Dataset has {dataset.size} examples < batch_size={batch_size}; "
                 f"the epoch would run zero steps")
-        last = {}
         count = 0
+        metrics = None
         for i, batch in enumerate(dataset.batches(batch_size, shuffle=True, seed=epoch_seed,
                                                   drop_remainder=True)):
             batch.pop("valid", None)
@@ -197,12 +197,9 @@ class TrainLoop:
             self.state, metrics = self._train_step(self.state, dev_batch)
             count += 1
             if on_metrics is not None and (i % 50 == 0):
-                m = {k: float(v) for k, v in metrics.items()}
-                on_metrics(i, m)
-                last = m
-        if count and not last:
-            last = {k: float(v) for k, v in metrics.items()}
-        return last
+                on_metrics(i, {k: float(v) for k, v in metrics.items()})
+        # Final-step metrics are the epoch result (one host sync per epoch).
+        return {k: float(v) for k, v in metrics.items()} if count else {}
 
     def evaluate(self, dataset, batch_size: int) -> float:
         total_correct = 0

@@ -1,0 +1,20 @@
+"""Parallelism: device meshes, within-trial data parallelism, ensemble
+sharding, and sequence parallelism.
+
+Reference contrast (SURVEY.md §2 "Parallelism strategies"): the
+reference's only parallelism is job-level (one trial per GPU container;
+one inference worker per served trial). This package adds the
+TPU-native axes the north star requires: within-trial data parallelism
+over ICI (mesh + sharding annotations → XLA psum), stacked-ensemble
+serving (vmap over trials, sharded over chips), and — for completeness
+beyond the reference — ring-attention sequence parallelism for
+long-context models.
+"""
+
+from rafiki_tpu.parallel.mesh import (
+    data_parallel_mesh,
+    local_devices,
+    partition_devices,
+)
+
+__all__ = ["data_parallel_mesh", "local_devices", "partition_devices"]

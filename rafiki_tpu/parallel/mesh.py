@@ -1,0 +1,50 @@
+"""Device mesh helpers.
+
+The scheduler thinks in *device sets*: each train worker owns a set of
+chips; a 1-chip set runs the trial under ``jax.default_device``; a
+k-chip set becomes a 1-axis ``Mesh(("dp",))`` for within-trial data
+parallelism (gradient all-reduce over ICI inserted by XLA from sharding
+annotations — see rafiki_tpu/ops/train.py).
+
+Multi-host: `jax.distributed.initialize()` + `jax.devices()` yields the
+global device list; the same partitioning logic then spans hosts, with
+collectives riding ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def local_devices(platform: Optional[str] = None) -> List:
+    import jax
+
+    return list(jax.local_devices()) if platform is None else [
+        d for d in jax.local_devices() if d.platform == platform
+    ]
+
+
+def data_parallel_mesh(devices: Sequence) -> "jax.sharding.Mesh":
+    """A 1-D mesh with axis "dp" over the given devices."""
+    import jax
+
+    return jax.sharding.Mesh(np.asarray(list(devices)), ("dp",))
+
+
+def partition_devices(devices: Sequence, n_workers: int) -> List[List]:
+    """Split a device list into n_workers contiguous groups (contiguous
+    device ids share ICI neighbourhoods on TPU slices).
+
+    len(devices) must be divisible by n_workers so every worker's dp
+    mesh has the same size (uniform trial throughput).
+    """
+    devices = list(devices)
+    if n_workers <= 0:
+        raise ValueError("n_workers must be positive")
+    if len(devices) % n_workers != 0:
+        raise ValueError(
+            f"{len(devices)} devices do not split evenly over {n_workers} workers")
+    per = len(devices) // n_workers
+    return [devices[i * per : (i + 1) * per] for i in range(n_workers)]

@@ -1,0 +1,13 @@
+"""Predictor: the serving frontend that fans queries out to inference
+workers and ensembles their predictions.
+
+Reference parity: rafiki/predictor/ (app.py, predictor.py, ensemble.py;
+unverified — SURVEY.md §3.2). The HTTP app lives in
+rafiki_tpu.predictor.app; the scatter/gather core and the ensemble
+math are importable without any server.
+"""
+
+from rafiki_tpu.predictor.ensemble import ensemble_predictions
+from rafiki_tpu.predictor.predictor import Predictor
+
+__all__ = ["Predictor", "ensemble_predictions"]

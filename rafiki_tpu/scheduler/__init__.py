@@ -1,0 +1,18 @@
+"""Trial schedulers: one trial per chip.
+
+Reference parity: the reference's "scheduler" is Docker Swarm +
+ServicesManager (one train-worker container per GPU — SURVEY.md §2).
+TPU-native replacements:
+
+  * LocalScheduler — threads in one process, each worker pinned to a
+    device set via ``jax.default_device`` / a dp mesh. Zero setup, used
+    by tests and single-host runs; workers share one XLA runtime.
+  * ProcessScheduler — one subprocess per worker with
+    ``JAX_VISIBLE_DEVICES=<chip>``: fully isolated XLA runtimes and
+    compilation caches, the robust production shape (SURVEY.md §7
+    "per-chip trial isolation").
+"""
+
+from rafiki_tpu.scheduler.local import LocalScheduler, TrainJobResult
+
+__all__ = ["LocalScheduler", "TrainJobResult"]

@@ -1,0 +1,141 @@
+"""In-process scheduler: N worker threads, each owning a device set.
+
+The thread-per-worker model is correct for TPU because the heavy work
+happens on device: the GIL is released during XLA execution, so k
+workers drive k chips concurrently from one Python process. (Compile
+contention is real — heavy production use should prefer
+ProcessScheduler — but for small trials and tests this is the simplest
+thing that works, and it's what the 8-device CPU fake pod exercises.)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from rafiki_tpu.advisor import AdvisorService
+from rafiki_tpu.constants import ServiceType, TrainJobStatus
+from rafiki_tpu.model.base import load_model_class
+from rafiki_tpu.parallel.mesh import local_devices, partition_devices
+from rafiki_tpu.store import MetaStore, ParamsStore
+from rafiki_tpu.worker.train import InProcAdvisorHandle, TrainWorker
+
+
+@dataclass
+class TrainJobResult:
+    job_id: str
+    status: str
+    trials: List[dict]
+    best_trials: List[dict]
+    duration_s: float
+    errors: List[str] = field(default_factory=list)
+
+
+class LocalScheduler:
+    def __init__(self, store: MetaStore, params_store: ParamsStore,
+                 advisor_service: Optional[AdvisorService] = None):
+        self.store = store
+        self.params_store = params_store
+        self.advisors = advisor_service or AdvisorService()
+
+    def run_train_job(
+        self,
+        job_id: str,
+        n_workers: Optional[int] = None,
+        devices: Optional[List[Any]] = None,
+        devices_per_trial: int = 1,
+        advisor_kind: str = "gp",
+        stop_event: Optional[threading.Event] = None,
+    ) -> TrainJobResult:
+        """Run a train job to budget exhaustion. Blocking; thread-safe.
+
+        Device math: with D devices and devices_per_trial=k, there are
+        D//k workers (each trial data-parallel over its k chips) unless
+        n_workers caps it lower. The per-model sub-jobs share the
+        worker pool sequentially (models are trained one after another,
+        each with full parallelism — simplest fair split; the budget is
+        per sub-job, as in the reference).
+        """
+        t0 = time.time()
+        job = self.store.get_train_job(job_id)
+        if job is None:
+            raise KeyError(f"No train job {job_id!r}")
+        self.store.update_train_job_status(job_id, TrainJobStatus.RUNNING.value)
+        stop_event = stop_event or threading.Event()
+
+        devices = devices if devices is not None else local_devices()
+        budget = dict(job["budget"])
+        chip_budget = budget.get("CHIP_COUNT") or budget.get("GPU_COUNT")
+        if chip_budget:
+            devices = devices[: int(chip_budget) * devices_per_trial]
+        max_workers = max(1, len(devices) // devices_per_trial)
+        n_workers = min(n_workers or max_workers, max_workers)
+        device_sets = partition_devices(devices[: n_workers * devices_per_trial], n_workers)
+
+        errors: List[str] = []
+        subs = self.store.get_sub_train_jobs(job_id)
+        if not subs:
+            raise ValueError(f"Train job {job_id} has no sub jobs (no models attached)")
+
+        for sub in subs:
+            model_row = self.store.get_model(sub["model_id"])
+            try:
+                model_cls = load_model_class(model_row["model_file"], model_row["model_class"])
+            except Exception as e:
+                self.store.update_sub_train_job(sub["id"], status=TrainJobStatus.ERRORED.value)
+                errors.append(f"model {model_row['name']}: {e}")
+                continue
+            advisor_id = self.advisors.create_advisor(
+                model_cls.get_knob_config(), kind=advisor_kind,
+                advisor_id=sub.get("advisor_id") or None)
+            self.store.update_sub_train_job(sub["id"], advisor_id=advisor_id,
+                                            status=TrainJobStatus.RUNNING.value)
+
+            threads = []
+            for i, dev_set in enumerate(device_sets):
+                service = self.store.create_service(
+                    ServiceType.TRAIN_WORKER.value, job_id=job_id, worker_index=i,
+                    devices=[str(d) for d in dev_set])
+                worker = TrainWorker(
+                    self.store, self.params_store, sub["id"], model_cls,
+                    InProcAdvisorHandle(self.advisors, advisor_id),
+                    job["train_dataset_uri"], job["val_dataset_uri"], budget,
+                    worker_id=f"{job_id[:8]}-w{i}", devices=dev_set,
+                    job_created_at=job["created_at"], service_id=service["id"],
+                    stop_event=stop_event,
+                )
+                th = threading.Thread(target=self._run_worker, args=(worker, errors),
+                                      name=f"train-worker-{i}", daemon=True)
+                threads.append(th)
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            self.store.update_sub_train_job(sub["id"], status=TrainJobStatus.COMPLETED.value)
+            self.advisors.delete_advisor(advisor_id)
+
+        subs_after = self.store.get_sub_train_jobs(job_id)
+        if stop_event.is_set():
+            status = TrainJobStatus.STOPPED.value
+        elif subs_after and all(s["status"] == TrainJobStatus.ERRORED.value for s in subs_after):
+            status = TrainJobStatus.ERRORED.value
+        else:
+            status = TrainJobStatus.COMPLETED.value
+        self.store.update_train_job_status(job_id, status)
+        return TrainJobResult(
+            job_id=job_id,
+            status=status,
+            trials=self.store.get_trials_of_train_job(job_id),
+            best_trials=self.store.get_best_trials_of_train_job(job_id, limit=2),
+            duration_s=time.time() - t0,
+            errors=errors,
+        )
+
+    @staticmethod
+    def _run_worker(worker: TrainWorker, errors: List[str]) -> None:
+        try:
+            worker.run()
+        except Exception as e:  # worker crash ≠ job crash; trials already contained
+            errors.append(f"worker {worker.worker_id}: {e!r}")

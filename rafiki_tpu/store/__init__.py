@@ -1,0 +1,14 @@
+"""Persistence layer: meta store (sqlite3) + params store (files).
+
+Reference parity: rafiki/db/ (schema.py + database.py, unverified
+paths — SURVEY.md §2): SQLAlchemy ORM over PostgreSQL with typed CRUD.
+Here: first-party sqlite3 (WAL mode) — single-file, multi-process-safe
+for the one-host-many-chips topology, with the same entity vocabulary
+(User, Model, TrainJob, SubTrainJob, Trial, InferenceJob, Service,
+TrialLog). Swappable for Postgres by reimplementing MetaStore's SQL.
+"""
+
+from rafiki_tpu.store.meta import MetaStore
+from rafiki_tpu.store.params import ParamsStore
+
+__all__ = ["MetaStore", "ParamsStore"]

@@ -1,0 +1,80 @@
+"""Params store: trained model parameters on disk, keyed by params id.
+
+Reference parity: the reference persists each trial's
+``dump_parameters()`` blob via the meta store / a shared params volume
+(SURVEY.md §5 "Checkpoint / resume"). Same trial-granular model here:
+one file per params id with sha256 integrity, plus a mid-trial
+checkpoint namespace (``<trial>/ckpt_<step>``) the reference lacks —
+used by the worker for resumable long trials.
+
+Blobs are whatever the model's ``dump_parameters`` returned (for
+JaxModel: a pickled dict holding flax msgpack bytes — a host-side
+pytree snapshot, cheap to write from one `jax.device_get`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import uuid
+from pathlib import Path
+from typing import List, Optional
+
+
+class ParamsStore:
+    def __init__(self, params_dir: str | os.PathLike):
+        self._dir = Path(params_dir)
+        self._dir.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, params_id: str) -> Path:
+        if "/" in params_id or ".." in params_id:
+            raise ValueError(f"Bad params id {params_id!r}")
+        return self._dir / f"{params_id}.params"
+
+    def save(self, blob: bytes, params_id: Optional[str] = None) -> str:
+        params_id = params_id or uuid.uuid4().hex
+        path = self._path(params_id)
+        tmp = path.with_suffix(".tmp")
+        digest = hashlib.sha256(blob).hexdigest().encode()
+        with open(tmp, "wb") as f:
+            f.write(digest + b"\n" + blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic: readers never see a torn file
+        return params_id
+
+    def load(self, params_id: str) -> bytes:
+        with open(self._path(params_id), "rb") as f:
+            digest, blob = f.read().split(b"\n", 1)
+        if hashlib.sha256(blob).hexdigest().encode() != digest:
+            raise IOError(f"Params {params_id} failed integrity check")
+        return blob
+
+    def exists(self, params_id: str) -> bool:
+        return self._path(params_id).exists()
+
+    def delete(self, params_id: str) -> None:
+        self._path(params_id).unlink(missing_ok=True)
+
+    def list(self) -> List[str]:
+        return sorted(p.stem for p in self._dir.glob("*.params"))
+
+    # -- mid-trial checkpoints ----------------------------------------------
+
+    def save_checkpoint(self, trial_id: str, step: int, blob: bytes) -> str:
+        return self.save(blob, params_id=f"{trial_id}_ckpt_{step}")
+
+    def latest_checkpoint(self, trial_id: str) -> Optional[tuple]:
+        """Return (step, blob) of the newest checkpoint for a trial."""
+        best = None
+        for p in self._dir.glob(f"{trial_id}_ckpt_*.params"):
+            step = int(p.stem.rsplit("_", 1)[1])
+            if best is None or step > best:
+                best = step
+        if best is None:
+            return None
+        return best, self.load(f"{trial_id}_ckpt_{best}")
+
+    def delete_checkpoints(self, trial_id: str) -> None:
+        for p in self._dir.glob(f"{trial_id}_ckpt_*.params"):
+            p.unlink(missing_ok=True)

@@ -1,0 +1,13 @@
+"""Data-plane workers: the processes/threads that touch devices.
+
+Reference parity: rafiki/worker/ (train.py, inference.py, unverified
+paths — SURVEY.md §2): worker entrypoints launched inside containers
+and driven by env vars. Here workers are plain objects runnable
+in-thread (LocalScheduler), or as subprocesses pinned to one chip
+(ProcessScheduler) — the TPU-native analog of one-container-per-GPU.
+"""
+
+from rafiki_tpu.worker.train import AdvisorHandle, InProcAdvisorHandle, TrainWorker
+from rafiki_tpu.worker.inference import InferenceWorker
+
+__all__ = ["TrainWorker", "AdvisorHandle", "InProcAdvisorHandle", "InferenceWorker"]

@@ -1,0 +1,60 @@
+"""Inference worker: serves one trained trial.
+
+Reference parity: rafiki/worker/inference.py (unverified — SURVEY.md
+§3.2): load the trial's params, register as running in the bus, then
+loop: pop a query batch from this worker's queue → model.predict →
+push predictions keyed by query id.
+
+TPU note: ``pop_queries`` drains the queue after the first query
+arrives, so concurrent requests are micro-batched into one forward
+pass — the device sees large batches, not query-at-a-time traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+import numpy as np
+
+from rafiki_tpu.model.base import BaseModel
+
+
+class InferenceWorker:
+    def __init__(self, bus, job_id: str, worker_id: str, model: BaseModel,
+                 batch_size: int = 64, stop_event: Optional[threading.Event] = None):
+        self.bus = bus
+        self.job_id = job_id
+        self.worker_id = worker_id
+        self.model = model
+        self.batch_size = batch_size
+        self._stop = stop_event or threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        self.bus.add_worker(self.job_id, self.worker_id)
+        try:
+            while not self._stop.is_set():
+                items = self.bus.pop_queries(self.worker_id, max_n=self.batch_size,
+                                             timeout=0.1)
+                if not items:
+                    continue
+                qids = [qid for qid, _ in items]
+                queries = [q for _, q in items]
+                try:
+                    preds = self._predict(queries)
+                except Exception as e:  # a bad query batch must not kill the worker
+                    preds = [{"error": str(e)}] * len(queries)
+                for qid, pred in zip(qids, preds):
+                    self.bus.put_prediction(qid, self.worker_id, pred)
+        finally:
+            self.bus.remove_worker(self.job_id, self.worker_id)
+
+    def _predict(self, queries: List[Any]) -> List[Any]:
+        # Array fast path (classification): one stacked forward pass.
+        if hasattr(self.model, "predict_proba"):
+            x = np.asarray(queries, dtype=np.float32)
+            return self.model.predict_proba(x).tolist()
+        return self.model.predict(queries)

@@ -1,0 +1,187 @@
+"""Train worker: the trial loop.
+
+Reference parity: rafiki/worker/train.py (unverified — SURVEY.md §3.1
+is the call stack): poll budget → create Trial row → get knobs from
+advisor → load model class → init(knobs) → train → evaluate →
+dump_parameters → persist score+params → feedback; mark trial ERRORED
+on exception and continue; stop when budget exhausted.
+
+TPU-native specifics:
+  * the worker owns a fixed set of jax devices (usually exactly one
+    chip — "one trial per chip"); trials run under
+    ``jax.default_device`` / a dp Mesh over those devices, so N workers
+    in one process drive N chips concurrently, and process-per-chip
+    workers isolate XLA runtimes entirely;
+  * trial-time model logs are captured via ``logger.capture`` into
+    TrialLog rows (same channel as the reference);
+  * each trial records its compiled-shape signature so schedulers can
+    measure and amortize XLA compile time across like-shaped trials.
+"""
+
+from __future__ import annotations
+
+import io
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Protocol
+
+from rafiki_tpu.constants import BudgetType, TrainJobStatus, TrialStatus
+from rafiki_tpu.model.base import BaseModel, load_model_class
+from rafiki_tpu.model.knobs import Knobs, knob_config_signature
+from rafiki_tpu.model.log import logger
+from rafiki_tpu.store import MetaStore, ParamsStore
+
+
+class AdvisorHandle(Protocol):
+    """What the worker needs from an advisor, local or remote."""
+
+    def propose(self) -> Knobs: ...
+
+    def feedback(self, score: float, knobs: Knobs) -> None: ...
+
+
+class InProcAdvisorHandle:
+    def __init__(self, advisor_service, advisor_id: str):
+        self._svc = advisor_service
+        self._id = advisor_id
+
+    def propose(self) -> Knobs:
+        return self._svc.propose(self._id)
+
+    def feedback(self, score: float, knobs: Knobs) -> None:
+        self._svc.feedback(self._id, score, knobs)
+
+
+class TrainWorker:
+    def __init__(
+        self,
+        store: MetaStore,
+        params_store: ParamsStore,
+        sub_train_job_id: str,
+        model_class: type,
+        advisor: AdvisorHandle,
+        train_dataset_uri: str,
+        val_dataset_uri: str,
+        budget: Dict[str, Any],
+        worker_id: str = "worker-0",
+        devices: Optional[List[Any]] = None,
+        job_created_at: Optional[float] = None,
+        service_id: Optional[str] = None,
+        stop_event=None,
+    ):
+        if not (isinstance(model_class, type) and issubclass(model_class, BaseModel)):
+            raise TypeError("model_class must subclass BaseModel")
+        self.store = store
+        self.params_store = params_store
+        self.sub_id = sub_train_job_id
+        self.model_class = model_class
+        self.advisor = advisor
+        self.train_uri = train_dataset_uri
+        self.val_uri = val_dataset_uri
+        self.budget = dict(budget or {})
+        self.worker_id = worker_id
+        self.devices = devices
+        self.job_created_at = job_created_at or time.time()
+        self.service_id = service_id
+        self._stop = stop_event
+        self.trials_run = 0
+
+    # -- budget --------------------------------------------------------------
+
+    def budget_exhausted(self) -> bool:
+        """Non-consuming checks (stop flag, wall clock). The trial-count
+        budget is enforced by the atomic claim in ``run()``."""
+        if self._stop is not None and self._stop.is_set():
+            return True
+        hours = self.budget.get(BudgetType.TIME_HOURS.value)
+        if hours is not None and time.time() - self.job_created_at >= float(hours) * 3600:
+            return True
+        return False
+
+    # -- one trial -----------------------------------------------------------
+
+    def run_trial(self, knobs: Knobs) -> dict:
+        knob_config = self.model_class.get_knob_config()
+        sig = knob_config_signature(knob_config, knobs)
+        trial = self.store.create_trial(
+            self.sub_id, self.model_class.__name__, knobs,
+            worker_id=self.worker_id, shape_sig=sig)
+        tid = trial["id"]
+
+        def sink(entry):
+            self.store.add_trial_log(tid, entry)
+
+        model: Optional[BaseModel] = None
+        try:
+            with logger.capture(sink), self._device_scope():
+                model = self.model_class(**knobs)
+                if self.devices is not None and len(self.devices) > 1 and hasattr(model, "set_mesh"):
+                    from rafiki_tpu.parallel.mesh import data_parallel_mesh
+
+                    model.set_mesh(data_parallel_mesh(self.devices))
+                model.train(self.train_uri)
+                score = float(model.evaluate(self.val_uri))
+                blob = model.dump_parameters()
+            params_id = self.params_store.save(blob)
+            self.store.mark_trial_as_completed(tid, score, params_id)
+            self.advisor.feedback(score, knobs)
+            return self.store.get_trial(tid)
+        except Exception:
+            err = traceback.format_exc()
+            self.store.mark_trial_as_errored(tid, err)
+            # Feed the advisor a floor score so it learns to avoid the
+            # region instead of re-proposing it (reference just skips).
+            try:
+                self.advisor.feedback(0.0, knobs)
+            except Exception:
+                pass
+            return self.store.get_trial(tid)
+        finally:
+            if model is not None:
+                model.destroy()
+
+    def _device_scope(self):
+        import contextlib
+
+        if self.devices and len(self.devices) == 1:
+            import jax
+
+            return jax.default_device(self.devices[0])
+        return contextlib.nullcontext()
+
+    # -- the loop ------------------------------------------------------------
+
+    def run(self) -> int:
+        """Pull trials until the budget is exhausted. Returns #trials run."""
+        max_trials = self.budget.get(BudgetType.MODEL_TRIAL_COUNT.value)
+        while not self.budget_exhausted():
+            if max_trials is not None and not self.store.claim_trial_slot(
+                    self.sub_id, int(max_trials)):
+                break
+            knobs = self.advisor.propose()
+            self.run_trial(knobs)
+            self.trials_run += 1
+            if self.service_id is not None:
+                self.store.update_service(self.service_id, heartbeat=True)
+        return self.trials_run
+
+
+def build_worker_from_store(store: MetaStore, params_store: ParamsStore,
+                            sub_train_job_id: str, advisor: AdvisorHandle,
+                            worker_id: str = "worker-0", devices=None,
+                            stop_event=None) -> TrainWorker:
+    """Reconstruct a TrainWorker from meta-store rows (the entrypoint a
+    subprocess worker uses, mirroring the reference's env-var-driven
+    container entrypoint)."""
+    sub_row = store._one("SELECT * FROM sub_train_jobs WHERE id=?", (sub_train_job_id,))
+    if sub_row is None:
+        raise KeyError(f"No sub train job {sub_train_job_id!r}")
+    job = store.get_train_job(sub_row["train_job_id"])
+    model = store.get_model(sub_row["model_id"])
+    model_cls = load_model_class(model["model_file"], model["model_class"])
+    return TrainWorker(
+        store, params_store, sub_train_job_id, model_cls, advisor,
+        job["train_dataset_uri"], job["val_dataset_uri"], job["budget"],
+        worker_id=worker_id, devices=devices, job_created_at=job["created_at"],
+        stop_event=stop_event,
+    )

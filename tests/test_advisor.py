@@ -1,0 +1,88 @@
+import numpy as np
+
+from rafiki_tpu.advisor import AdvisorService, GpAdvisor, RandomAdvisor, make_advisor
+from rafiki_tpu.model.knobs import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob
+
+
+def _config():
+    return {
+        "x": FloatKnob(-2.0, 2.0),
+        "y": FloatKnob(1e-3, 1e1, is_exp=True),
+        "n": IntegerKnob(1, 8),
+        "c": CategoricalKnob(["a", "b"]),
+        "fixed": FixedKnob(42),
+    }
+
+
+def _objective(knobs):
+    # peak at x=0.5, y=1.0, n=4, c='b'
+    return (
+        -((knobs["x"] - 0.5) ** 2)
+        - (np.log10(knobs["y"]) ** 2)
+        - 0.05 * (knobs["n"] - 4) ** 2
+        + (0.5 if knobs["c"] == "b" else 0.0)
+    )
+
+
+def test_random_advisor_proposals_valid():
+    adv = RandomAdvisor(_config(), seed=0)
+    from rafiki_tpu.model.knobs import validate_knobs
+
+    for _ in range(50):
+        knobs = adv.propose()
+        validate_knobs(_config(), knobs)
+        assert knobs["fixed"] == 42
+
+
+def test_gp_advisor_beats_random():
+    """GP should find a better optimum than random search on a smooth
+    objective with the same budget (the reference's raison d'être)."""
+    budget = 30
+    results = {}
+    for kind, seed_offset in (("gp", 0), ("random", 0)):
+        bests = []
+        for seed in range(3):
+            adv = make_advisor(_config(), kind=kind, seed=seed + seed_offset)
+            for _ in range(budget):
+                knobs = adv.propose()
+                adv.feedback(_objective(knobs), knobs)
+            bests.append(adv.best()[1])
+        results[kind] = np.mean(bests)
+    assert results["gp"] >= results["random"] - 0.05, results
+
+
+def test_gp_pending_points_drain():
+    adv = GpAdvisor(_config(), seed=0, n_initial=4)
+    for _ in range(12):
+        knobs = adv.propose()
+        adv.feedback(_objective(knobs), knobs)
+    assert len(adv._pending) == 0  # every proposal scored → removed
+
+
+def test_gp_concurrent_proposals_differ():
+    adv = GpAdvisor(_config(), seed=0, n_initial=4)
+    for _ in range(8):
+        knobs = adv.propose()
+        adv.feedback(_objective(knobs), knobs)
+    a = adv.propose()
+    b = adv.propose()  # liar penalty should push b away from a
+    assert a != b
+
+
+def test_advisor_service_registry():
+    svc = AdvisorService()
+    aid = svc.create_advisor(_config(), kind="random", seed=1)
+    knobs = svc.propose(aid)
+    svc.feedback(aid, 0.5, knobs)
+    assert svc.best(aid)[1] == 0.5
+    svc.delete_advisor(aid)
+    try:
+        svc.propose(aid)
+        assert False
+    except KeyError:
+        pass
+
+
+def test_fixed_only_space():
+    adv = make_advisor({"k": FixedKnob(1)}, kind="gp")
+    assert adv.propose() == {"k": 1}

@@ -1,0 +1,111 @@
+"""Inference path: bus, workers, ensemble, predictor scatter/gather."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.bus import InProcBus
+from rafiki_tpu.predictor import Predictor, ensemble_predictions
+from rafiki_tpu.worker.inference import InferenceWorker
+
+
+def test_ensemble_mean_prob():
+    p = ensemble_predictions([[0.8, 0.2], [0.6, 0.4]])
+    np.testing.assert_allclose(p, [0.7, 0.3])
+
+
+def test_ensemble_skips_errors():
+    p = ensemble_predictions([{"error": "x"}, [0.5, 0.5]])
+    np.testing.assert_allclose(p, [0.5, 0.5])
+
+
+def test_ensemble_all_errors():
+    p = ensemble_predictions([{"error": "x"}, {"error": "y"}])
+    assert "error" in p
+
+
+def test_ensemble_non_numeric_falls_back():
+    assert ensemble_predictions(["NN", "VB"]) == "NN"
+
+
+def test_ensemble_mismatched_shapes_falls_back():
+    assert ensemble_predictions([[0.5, 0.5], [0.3, 0.3, 0.4]]) == [0.5, 0.5]
+
+
+class _ConstModel:
+    """Stand-in model: returns a fixed prob vector per query."""
+
+    def __init__(self, vec):
+        self.vec = list(vec)
+
+    def predict(self, queries):
+        return [self.vec for _ in queries]
+
+
+def test_predictor_fan_out_gather_ensemble():
+    bus = InProcBus()
+    stop = threading.Event()
+    w1 = InferenceWorker(bus, "job1", "w1", _ConstModel([0.9, 0.1]), stop_event=stop)
+    w2 = InferenceWorker(bus, "job1", "w2", _ConstModel([0.5, 0.5]), stop_event=stop)
+    t1 = threading.Thread(target=w1.run, daemon=True)
+    t2 = threading.Thread(target=w2.run, daemon=True)
+    t1.start(), t2.start()
+    try:
+        for _ in range(100):
+            if len(bus.get_workers("job1")) == 2:
+                break
+            time.sleep(0.01)
+        pred = Predictor(bus, "job1", timeout_s=5.0)
+        out = pred.predict([[1.0], [2.0], [3.0]])
+        assert len(out) == 3
+        np.testing.assert_allclose(out[0], [0.7, 0.3])
+    finally:
+        stop.set()
+        t1.join(timeout=2), t2.join(timeout=2)
+    assert bus.get_workers("job1") == []
+
+
+def test_predictor_no_workers_raises():
+    bus = InProcBus()
+    with pytest.raises(RuntimeError):
+        Predictor(bus, "nojob").predict([[1.0]])
+
+
+def test_worker_error_contained():
+    class Exploding:
+        def predict(self, queries):
+            raise ValueError("boom")
+
+    bus = InProcBus()
+    stop = threading.Event()
+    w = InferenceWorker(bus, "j", "w", Exploding(), stop_event=stop)
+    t = threading.Thread(target=w.run, daemon=True)
+    t.start()
+    try:
+        for _ in range(100):
+            if bus.get_workers("j"):
+                break
+            time.sleep(0.01)
+        out = Predictor(bus, "j", timeout_s=5.0).predict([[1.0]])
+        assert "error" in out[0]
+    finally:
+        stop.set()
+        t.join(timeout=2)
+
+
+def test_mp_bus_same_interface():
+    from rafiki_tpu.bus import make_mp_bus
+
+    bus = make_mp_bus()
+    bus.add_worker("j", "w1")
+    assert bus.get_workers("j") == ["w1"]
+    bus.add_query("w1", "q1", [1.0])
+    items = bus.pop_queries("w1", timeout=1.0)
+    assert items == [("q1", [1.0])]
+    bus.put_prediction("q1", "w1", [0.5])
+    preds = bus.get_predictions("q1", n=1, timeout=2.0)
+    assert preds == [("w1", [0.5])]
+    bus.remove_worker("j", "w1")
+    assert bus.get_workers("j") == []

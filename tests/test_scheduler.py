@@ -1,0 +1,125 @@
+"""End-to-end train-job scheduling on the fake 8-chip CPU pod."""
+
+import threading
+
+import pytest
+
+from rafiki_tpu.scheduler import LocalScheduler
+from rafiki_tpu.store import MetaStore, ParamsStore
+
+FF_SOURCE = b"""
+from rafiki_tpu.model.base import JaxModel
+from rafiki_tpu.model.knobs import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob
+from rafiki_tpu.models.ff import _Mlp
+
+class TinyFF(JaxModel):
+    @staticmethod
+    def get_knob_config():
+        return {
+            "hidden_units": CategoricalKnob([16, 32], affects_shape=True),
+            "learning_rate": FloatKnob(1e-3, 3e-2, is_exp=True),
+            "batch_size": FixedKnob(32),
+            "epochs": FixedKnob(1),
+        }
+
+    def build_module(self, num_classes, input_shape):
+        return _Mlp(hidden_layers=1, hidden_units=int(self.knobs["hidden_units"]),
+                    num_classes=num_classes)
+"""
+
+TRAIN = "synthetic://images?classes=5&n=256&w=8&h=8&seed=0"
+VAL = "synthetic://images?classes=5&n=128&w=8&h=8&seed=1"
+
+
+@pytest.fixture()
+def env(tmp_path):
+    store = MetaStore(tmp_path / "meta.sqlite3")
+    params = ParamsStore(tmp_path / "params")
+    model = store.create_model("tinyff", "IMAGE_CLASSIFICATION", None, FF_SOURCE, "TinyFF")
+    return store, params, model
+
+
+def _make_job(store, model, budget):
+    job = store.create_train_job("myapp", "IMAGE_CLASSIFICATION", None, TRAIN, VAL, budget)
+    store.create_sub_train_job(job["id"], model["id"])
+    return job
+
+
+def test_train_job_trial_count_budget(env):
+    store, params, model = env
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 4})
+    sched = LocalScheduler(store, params)
+    result = sched.run_train_job(job["id"], n_workers=2, advisor_kind="random")
+    assert result.status == "COMPLETED"
+    assert len(result.trials) == 4  # atomic claim: never overshoots
+    completed = [t for t in result.trials if t["status"] == "COMPLETED"]
+    assert len(completed) == 4
+    assert all(t["params_id"] for t in completed)
+    assert result.best_trials[0]["score"] >= max(t["score"] for t in completed) - 1e-9
+    # params are loadable
+    blob = params.load(result.best_trials[0]["params_id"])
+    assert len(blob) > 100
+
+
+def test_parallel_workers_share_budget(env):
+    store, params, model = env
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 6})
+    sched = LocalScheduler(store, params)
+    result = sched.run_train_job(job["id"], n_workers=4, advisor_kind="random")
+    assert len(result.trials) == 6
+    workers = {t["worker_id"] for t in result.trials}
+    assert len(workers) >= 2  # work actually spread across workers
+
+
+def test_erroring_model_contained(env):
+    store, params, model = env
+    bad_src = b"""
+from rafiki_tpu.model.base import BaseModel
+from rafiki_tpu.model.knobs import FloatKnob
+
+class Bad(BaseModel):
+    @staticmethod
+    def get_knob_config():
+        return {"lr": FloatKnob(0.0, 1.0)}
+    def train(self, uri):
+        raise RuntimeError("bad knob region" if self.knobs["lr"] > 0.5 else "always bad")
+    def evaluate(self, uri):
+        return 0.0
+    def predict(self, queries):
+        return []
+"""
+    bad = store.create_model("bad", "IMAGE_CLASSIFICATION", None, bad_src, "Bad")
+    job = store.create_train_job("badapp", "IMAGE_CLASSIFICATION", None, TRAIN, VAL,
+                                 {"MODEL_TRIAL_COUNT": 3})
+    store.create_sub_train_job(job["id"], bad["id"])
+    sched = LocalScheduler(store, params)
+    result = sched.run_train_job(job["id"], n_workers=2, advisor_kind="random")
+    assert result.status == "COMPLETED"  # job completes; trials errored
+    assert len(result.trials) == 3
+    assert all(t["status"] == "ERRORED" for t in result.trials)
+    assert "bad" in (result.trials[0]["error"] or "")
+
+
+def test_stop_event_halts_job(env):
+    store, params, model = env
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 50})
+    sched = LocalScheduler(store, params)
+    stop = threading.Event()
+
+    timer = threading.Timer(6.0, stop.set)
+    timer.start()
+    result = sched.run_train_job(job["id"], n_workers=2, advisor_kind="random",
+                                 stop_event=stop)
+    timer.cancel()
+    assert result.status == "STOPPED"
+    assert len(result.trials) < 50
+
+
+def test_trial_logs_captured(env):
+    store, params, model = env
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 1})
+    sched = LocalScheduler(store, params)
+    result = sched.run_train_job(job["id"], n_workers=1, advisor_kind="random")
+    logs = store.get_trial_logs(result.trials[0]["id"])
+    assert any(e["type"] == "plot" for e in logs)
+    assert any(e["type"] == "values" and "loss" in e.get("values", {}) for e in logs)

@@ -1,0 +1,133 @@
+import threading
+
+import pytest
+
+from rafiki_tpu.constants import TrainJobStatus, TrialStatus
+from rafiki_tpu.store import MetaStore, ParamsStore
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return MetaStore(tmp_path / "meta.sqlite3")
+
+
+def test_users(store):
+    u = store.create_user("a@b.c", "hash", "ADMIN")
+    assert store.get_user_by_email("a@b.c")["id"] == u["id"]
+    store.ban_user(u["id"])
+    assert store.get_user(u["id"])["banned"] == 1
+
+
+def test_models(store):
+    m = store.create_model("ff", "IMAGE_CLASSIFICATION", None, b"code", "FF",
+                           dependencies={"flax": "*"})
+    got = store.get_model_by_name("ff")
+    assert got["model_file"] == b"code"
+    assert got["dependencies"] == {"flax": "*"}
+    assert store.get_models_of_task("IMAGE_CLASSIFICATION")[0]["id"] == m["id"]
+    assert store.get_models_of_task("POS_TAGGING") == []
+
+
+def test_train_job_versioning(store):
+    j1 = store.create_train_job("app", "T", None, "u1", "u2", {"MODEL_TRIAL_COUNT": 3})
+    j2 = store.create_train_job("app", "T", None, "u1", "u2", {"MODEL_TRIAL_COUNT": 3})
+    assert (j1["app_version"], j2["app_version"]) == (1, 2)
+    assert store.get_train_job_by_app("app")["id"] == j2["id"]
+    assert store.get_train_job_by_app("app", app_version=1)["id"] == j1["id"]
+    assert j1["budget"] == {"MODEL_TRIAL_COUNT": 3}
+
+
+def test_trial_lifecycle_and_best(store):
+    j = store.create_train_job("app", "T", None, "u1", "u2", {})
+    s = store.create_sub_train_job(j["id"], "model1")
+    scores = [0.5, 0.9, 0.7, None]
+    for i, sc in enumerate(scores):
+        t = store.create_trial(s["id"], "ff", {"lr": i}, worker_id=f"w{i}")
+        assert t["no"] == i + 1
+        if sc is None:
+            store.mark_trial_as_errored(t["id"], "boom")
+        else:
+            store.mark_trial_as_completed(t["id"], sc, params_id=f"p{i}")
+    best = store.get_best_trials_of_train_job(j["id"], limit=2)
+    assert [b["score"] for b in best] == [0.9, 0.7]
+    assert best[0]["params_id"] == "p1"
+    assert store.count_trials_of_sub_train_job(s["id"]) == 4
+    assert store.count_trials_of_sub_train_job(
+        s["id"], statuses=[TrialStatus.ERRORED.value]) == 1
+    trials = store.get_trials_of_train_job(j["id"])
+    assert len(trials) == 4 and trials[0]["knobs"] == {"lr": 0}
+
+
+def test_trial_logs(store):
+    j = store.create_train_job("app", "T", None, "u", "v", {})
+    s = store.create_sub_train_job(j["id"], "m")
+    t = store.create_trial(s["id"], "ff", {})
+    store.add_trial_log(t["id"], {"type": "values", "values": {"loss": 0.5}, "time": 1.0})
+    store.add_trial_log(t["id"], {"type": "message", "message": "hi", "time": 2.0})
+    logs = store.get_trial_logs(t["id"])
+    assert len(logs) == 2 and logs[0]["values"]["loss"] == 0.5
+
+
+def test_concurrent_writes(store, tmp_path):
+    j = store.create_train_job("app", "T", None, "u", "v", {})
+    s = store.create_sub_train_job(j["id"], "m")
+
+    def worker(i):
+        # every thread gets its own connection via threading.local
+        t = store.create_trial(s["id"], "ff", {"i": i}, worker_id=f"w{i}")
+        store.mark_trial_as_completed(t["id"], i / 10, params_id=None)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    trials = store.get_trials_of_sub_train_job(s["id"])
+    assert len(trials) == 8
+    assert sorted(t["no"] for t in trials) == list(range(1, 9)) or len({t["id"] for t in trials}) == 8
+
+
+def test_inference_jobs_and_services(store):
+    j = store.create_train_job("app", "T", None, "u", "v", {})
+    i = store.create_inference_job(j["id"], None)
+    store.update_inference_job(i["id"], status="RUNNING", predictor_host="127.0.0.1:30000")
+    got = store.get_inference_job_of_train_job(j["id"])
+    assert got["predictor_host"] == "127.0.0.1:30000"
+    s = store.create_service("TRAIN_WORKER", job_id=j["id"], worker_index=0, devices=["tpu:0"])
+    store.update_service(s["id"], status="RUNNING", heartbeat=True)
+    assert store.get_services_of_job(j["id"])[0]["status"] == "RUNNING"
+
+
+def test_params_store_round_trip(tmp_path):
+    ps = ParamsStore(tmp_path / "params")
+    pid = ps.save(b"weights-blob")
+    assert ps.load(pid) == b"weights-blob"
+    assert ps.exists(pid)
+    assert pid in ps.list()
+    ps.delete(pid)
+    assert not ps.exists(pid)
+
+
+def test_params_store_integrity(tmp_path):
+    ps = ParamsStore(tmp_path / "params")
+    pid = ps.save(b"data")
+    # corrupt the file
+    path = ps._path(pid)
+    raw = path.read_bytes()
+    path.write_bytes(raw[:-1] + b"X")
+    with pytest.raises(IOError):
+        ps.load(pid)
+
+
+def test_params_store_checkpoints(tmp_path):
+    ps = ParamsStore(tmp_path / "params")
+    ps.save_checkpoint("trial1", 10, b"s10")
+    ps.save_checkpoint("trial1", 20, b"s20")
+    step, blob = ps.latest_checkpoint("trial1")
+    assert (step, blob) == (20, b"s20")
+    ps.delete_checkpoints("trial1")
+    assert ps.latest_checkpoint("trial1") is None
+
+
+def test_params_store_rejects_traversal(tmp_path):
+    ps = ParamsStore(tmp_path / "params")
+    with pytest.raises(ValueError):
+        ps.load("../etc/passwd")
