@@ -1,0 +1,131 @@
+"""Benchmark: CIFAR-10-class AutoML trial throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": "cifar10_automl_trials_per_hour", "value": N,
+   "unit": "trials/hour/chip", "vs_baseline": R}
+
+Method: measure steady-state bf16 training throughput (images/sec) and
+evaluation throughput of the canonical workload — VGG16 (width 1.0,
+batch 128) on CIFAR-shaped data (32x32x3) — on this chip, plus the
+measured fixed per-trial overhead (advisor propose/feedback + params
+dump). From those, compute the wall-clock of one canonical AutoML
+trial (1 epoch over the 50,000-image CIFAR-10 train split + eval over
+the 10,000-image test split) and report trials/hour.
+
+vs_baseline: the 8xV100 reference baseline from BASELINE.md — the
+reference publishes no numbers (BASELINE.json "published": {}), so the
+documented estimate there is 120 trials/hour/GPU for this canonical
+trial (V100 mixed-precision VGG16 CIFAR-10 ≈ 1.8k img/s → ~28s/epoch
++ eval + AutoML overhead ≈ 30s/trial). vs_baseline = value / 120,
+i.e. the per-chip ratio; the v5e-8 vs 8xV100 pod ratio is the same
+number. The north-star target is vs_baseline ≥ 8.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+CANON_TRAIN = 50_000
+CANON_EVAL = 10_000
+BASELINE_TRIALS_PER_HOUR_PER_GPU = 120.0
+
+
+def main() -> None:
+    import jax
+    import optax
+    import jax.numpy as jnp
+
+    from rafiki_tpu.models.vgg import _Vgg
+    from rafiki_tpu.ops.train import TrainLoop, cross_entropy_loss
+
+    batch = 128
+    module = _Vgg(depth=16, width_mult=1.0, num_classes=10, dropout=0.1)
+
+    def apply_fn(params, b, train=False, rng=None):
+        kwargs = {"rngs": {"dropout": rng}} if rng is not None else {}
+        return module.apply({"params": params}, b["x"], train=train, **kwargs)
+
+    def init_fn(rng):
+        return module.init(rng, jnp.zeros((1, 32, 32, 3)), train=False)["params"]
+
+    def loss_fn(params, b, rng):
+        logits = apply_fn(params, b, train=True, rng=rng)
+        loss, acc = cross_entropy_loss(logits, b["y"])
+        return loss, {"acc": acc}
+
+    loop = TrainLoop(init_fn, apply_fn, loss_fn, optax.adam(1e-3), seed=0)
+
+    rng = np.random.default_rng(0)
+    b = {
+        "x": rng.uniform(0, 1, size=(batch, 32, 32, 3)).astype(np.float32),
+        "y": rng.integers(0, 10, size=(batch,)).astype(np.int32),
+    }
+    dev_b = loop.plan.put_batch(b)
+
+    # -- train throughput (compile, warm up, then time) ---------------------
+    # NOTE: hard-sync with device_get, not block_until_ready — on the
+    # axon-tunnelled TPU the latter returns before execution finishes,
+    # inflating throughput ~10x.
+    t_compile0 = time.monotonic()
+    loop.state, m = loop._train_step(loop.state, dev_b)
+    float(jax.device_get(m["loss"]))
+    compile_s = time.monotonic() - t_compile0
+    for _ in range(5):
+        loop.state, m = loop._train_step(loop.state, dev_b)
+    float(jax.device_get(m["loss"]))
+    steps = 100
+    t0 = time.monotonic()
+    for _ in range(steps):
+        loop.state, m = loop._train_step(loop.state, dev_b)
+    float(jax.device_get(m["loss"]))
+    train_img_s = steps * batch / (time.monotonic() - t0)
+
+    # -- eval throughput -----------------------------------------------------
+    c, n = loop._eval_step(loop.state[0], dev_b)
+    int(jax.device_get(c))
+    t0 = time.monotonic()
+    for _ in range(30):
+        c, n = loop._eval_step(loop.state[0], dev_b)
+    int(jax.device_get(c))
+    eval_img_s = 30 * batch / (time.monotonic() - t0)
+
+    # -- fixed per-trial overhead: advisor round + params dump --------------
+    from rafiki_tpu.advisor import make_advisor
+    from rafiki_tpu.models.vgg import Vgg
+    from flax import serialization
+
+    adv = make_advisor(Vgg.get_knob_config(), kind="gp", seed=0)
+    t0 = time.monotonic()
+    for _ in range(3):
+        knobs = adv.propose()
+        adv.feedback(0.5, knobs)
+    advisor_s = (time.monotonic() - t0) / 3
+    t0 = time.monotonic()
+    blob = serialization.to_bytes(jax.device_get(loop.params))
+    dump_s = time.monotonic() - t0
+
+    trial_s = (CANON_TRAIN / train_img_s) + (CANON_EVAL / eval_img_s) + advisor_s + dump_s
+    trials_per_hour = 3600.0 / trial_s
+    out = {
+        "metric": "cifar10_automl_trials_per_hour",
+        "value": round(trials_per_hour, 2),
+        "unit": "trials/hour/chip",
+        "vs_baseline": round(trials_per_hour / BASELINE_TRIALS_PER_HOUR_PER_GPU, 3),
+        "detail": {
+            "train_img_per_s": round(train_img_s, 1),
+            "eval_img_per_s": round(eval_img_s, 1),
+            "canonical_trial_s": round(trial_s, 2),
+            "compile_s": round(compile_s, 1),
+            "advisor_s_per_trial": round(advisor_s, 3),
+            "params_dump_s": round(dump_s, 3),
+            "device": str(jax.devices()[0]),
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -15,16 +15,20 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 
 class InProcBus:
+    _EXPIRED_CAP = 4096  # remembered timed-out query ids (leak guard)
+
     def __init__(self):
         self._queues: Dict[str, queue.Queue] = defaultdict(queue.Queue)
         self._preds: Dict[str, list] = {}
         self._pred_cv = threading.Condition()
         self._workers: Dict[str, set] = defaultdict(set)
+        self._expired: "deque[str]" = deque(maxlen=self._EXPIRED_CAP)
+        self._expired_set: set = set()
         self._lock = threading.Lock()
 
     # -- worker registry -----------------------------------------------------
@@ -36,6 +40,7 @@ class InProcBus:
     def remove_worker(self, job_id: str, worker_id: str) -> None:
         with self._lock:
             self._workers[job_id].discard(worker_id)
+        self._queues.pop(worker_id, None)  # drop the dead worker's queue
 
     def get_workers(self, job_id: str) -> List[str]:
         with self._lock:
@@ -67,12 +72,15 @@ class InProcBus:
 
     def put_prediction(self, query_id: str, worker_id: str, prediction: Any) -> None:
         with self._pred_cv:
+            if query_id in self._expired_set:
+                return  # late answer to a timed-out query: drop, don't leak
             self._preds.setdefault(query_id, []).append((worker_id, prediction))
             self._pred_cv.notify_all()
 
     def get_predictions(self, query_id: str, n: int,
                         timeout: float = 10.0) -> List[Tuple[str, Any]]:
-        """Wait until n predictions arrived (or timeout); pops the slot."""
+        """Wait until n predictions arrived (or timeout); pops the slot.
+        After this returns, late answers for query_id are discarded."""
         deadline = time.monotonic() + timeout
         with self._pred_cv:
             while len(self._preds.get(query_id, [])) < n:
@@ -80,6 +88,10 @@ class InProcBus:
                 if remaining <= 0:
                     break
                 self._pred_cv.wait(remaining)
+            if len(self._expired) == self._expired.maxlen:
+                self._expired_set.discard(self._expired[0])
+            self._expired.append(query_id)
+            self._expired_set.add(query_id)
             return self._preds.pop(query_id, [])
 
 
@@ -103,6 +115,7 @@ class _MpBus:
         self._queues = manager.dict()   # worker_id -> manager.Queue
         self._preds = manager.dict()    # query_id -> manager.list
         self._workers = manager.dict()  # job_id -> manager.list
+        self._expired = manager.dict()  # gathered/timed-out query ids
         self._lock = manager.Lock()
 
     def _q(self, worker_id: str):
@@ -153,6 +166,8 @@ class _MpBus:
 
     def put_prediction(self, query_id, worker_id, prediction):
         with self._lock:
+            if query_id in self._expired:
+                return  # late answer to a timed-out query: drop, don't leak
             preds = self._preds.get(query_id)
             if preds is None:
                 preds = self._manager.list()
@@ -170,4 +185,7 @@ class _MpBus:
             time.sleep(0.005)
         with self._lock:
             preds = self._preds.pop(query_id, None)
+            self._expired[query_id] = True
+            if len(self._expired) > 4096:
+                self._expired.clear()  # coarse cap; stale ids just re-leak one slot
         return list(preds) if preds is not None else []

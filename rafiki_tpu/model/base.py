@@ -130,7 +130,16 @@ class JaxModel(BaseModel):
     def make_optimizer(self):
         import optax
 
-        return optax.adam(self.learning_rate)
+        # Linear warmup guards deep nets (GroupNorm + bf16) against the
+        # early-step collapse that makes high-lr trials score as noise —
+        # without it the advisor's lr axis has a cliff instead of a slope.
+        # Capped at 10% of the planned steps so short trials still train.
+        planned = getattr(self, "_planned_steps", None)
+        warmup = int(self.knobs.get(
+            "warmup_steps",
+            min(100, max(1, (planned or 1000) // 10))))
+        sched = optax.linear_schedule(0.0, self.learning_rate, warmup)
+        return optax.adam(sched)
 
     def preprocess(self, x: np.ndarray) -> np.ndarray:
         return x
@@ -190,6 +199,7 @@ class JaxModel(BaseModel):
         ds = Dataset(self.preprocess(ds.x), ds.y, ds.classes, ds.mask, ds.meta)
         self._dataset_meta = dict(ds.meta)
         num_classes, input_shape = self._dataset_arch(ds)
+        self._planned_steps = self.epochs * max(1, ds.size // self.batch_size)
         if self._loop is None:
             self._build_loop(num_classes, input_shape)
         elif self._arch != (num_classes, input_shape):

@@ -122,8 +122,15 @@ def synthetic_images(classes=10, w=28, h=28, c=1, n=2048, seed=0, noise=0.35,
     ``dist`` and differ in ``seed`` — otherwise they would be different
     classification problems and generalization would be impossible.
     """
-    templates = (np.random.default_rng(dist)
-                 .uniform(0.0, 1.0, size=(classes, h, w, c)).astype(np.float32))
+    # Low-spatial-frequency templates (drawn coarse, then upsampled):
+    # learnable both by flatten-head models (MLP/VGG) and by
+    # global-average-pool heads (DenseNet), which can't see per-pixel
+    # high-frequency patterns.
+    th, tw = max(2, h // 4), max(2, w // 4)
+    coarse = (np.random.default_rng(dist)
+              .uniform(0.0, 1.0, size=(classes, th, tw, c)).astype(np.float32))
+    templates = np.repeat(np.repeat(coarse, h // th + 1, axis=1), w // tw + 1, axis=2)
+    templates = templates[:, :h, :w, :]
     rng = np.random.default_rng(seed + 1_000_003)
     y = rng.integers(0, classes, size=n).astype(np.int32)
     x = templates[y] + rng.normal(0.0, noise, size=(n, h, w, c)).astype(np.float32)

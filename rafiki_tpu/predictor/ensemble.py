@@ -26,10 +26,14 @@ def ensemble_predictions(predictions: Sequence[Any]) -> Any:
     if not preds:
         return {"error": "all workers errored", "detail": list(predictions)[:3]}
     try:
-        arrs = [np.asarray(p, dtype=np.float64) for p in preds]
+        arrs = [np.asarray(p) for p in preds]
     except (ValueError, TypeError):
         return preds[0]
-    if any(a.shape != arrs[0].shape or a.ndim == 0 for a in arrs):
+    # Only *float* arrays are probability vectors we can average;
+    # integer arrays are class labels / tag sequences (averaging tag
+    # ids is meaningless) → fall back to the best worker's answer.
+    if any(a.shape != arrs[0].shape or a.ndim == 0
+           or not np.issubdtype(a.dtype, np.floating) for a in arrs):
         return preds[0]
     mean = np.mean(arrs, axis=0)
     # Re-normalize probability vectors so the ensemble is a distribution.
@@ -38,10 +42,3 @@ def ensemble_predictions(predictions: Sequence[Any]) -> Any:
         with np.errstate(invalid="ignore", divide="ignore"):
             mean = np.where(s > 0, mean / s, mean)
     return mean.tolist()
-
-
-def ensemble_batch(predictions_per_worker: Sequence[Sequence[Any]]) -> List[Any]:
-    """Combine k workers' aligned prediction lists for a batch of queries."""
-    n = len(predictions_per_worker[0])
-    return [ensemble_predictions([w[i] for w in predictions_per_worker])
-            for i in range(n)]

@@ -8,6 +8,7 @@ the job, await all predictions with a timeout, ensemble, respond.
 
 from __future__ import annotations
 
+import time
 import uuid
 from typing import Any, List, Optional
 
@@ -31,10 +32,14 @@ class Predictor:
             qids.append(qid)
             for w in workers:
                 self.bus.add_query(w, qid, query)
+        # One deadline for the whole batch: a dead-but-registered worker
+        # costs at most timeout_s total, not timeout_s per query, and
+        # partial gathers still ensemble whatever arrived.
+        deadline = time.monotonic() + self.timeout_s
         out: List[Any] = []
         for qid in qids:
-            preds = self.bus.get_predictions(qid, n=len(workers),
-                                             timeout=self.timeout_s)
+            remaining = max(0.05, deadline - time.monotonic())
+            preds = self.bus.get_predictions(qid, n=len(workers), timeout=remaining)
             if not preds:
                 out.append({"error": "prediction timeout"})
             else:

@@ -80,6 +80,9 @@ class LocalScheduler:
             raise ValueError(f"Train job {job_id} has no sub jobs (no models attached)")
 
         for sub in subs:
+            if stop_event.is_set():
+                self.store.update_sub_train_job(sub["id"], status=TrainJobStatus.STOPPED.value)
+                continue
             model_row = self.store.get_model(sub["model_id"])
             try:
                 model_cls = load_model_class(model_row["model_file"], model_row["model_class"])
@@ -94,10 +97,12 @@ class LocalScheduler:
                                             status=TrainJobStatus.RUNNING.value)
 
             threads = []
+            services = []
             for i, dev_set in enumerate(device_sets):
                 service = self.store.create_service(
                     ServiceType.TRAIN_WORKER.value, job_id=job_id, worker_index=i,
                     devices=[str(d) for d in dev_set])
+                services.append(service)
                 worker = TrainWorker(
                     self.store, self.params_store, sub["id"], model_cls,
                     InProcAdvisorHandle(self.advisors, advisor_id),
@@ -109,11 +114,22 @@ class LocalScheduler:
                 th = threading.Thread(target=self._run_worker, args=(worker, errors),
                                       name=f"train-worker-{i}", daemon=True)
                 threads.append(th)
+            for svc in services:
+                self.store.update_service(svc["id"], status="RUNNING")
             for th in threads:
                 th.start()
             for th in threads:
                 th.join()
-            self.store.update_sub_train_job(sub["id"], status=TrainJobStatus.COMPLETED.value)
+            for svc in services:
+                self.store.update_service(svc["id"], status="STOPPED")
+            trials = self.store.get_trials_of_sub_train_job(sub["id"])
+            if stop_event.is_set():
+                sub_status = TrainJobStatus.STOPPED.value
+            elif trials and all(t["status"] == "ERRORED" for t in trials):
+                sub_status = TrainJobStatus.ERRORED.value
+            else:
+                sub_status = TrainJobStatus.COMPLETED.value
+            self.store.update_sub_train_job(sub["id"], status=sub_status)
             self.advisors.delete_advisor(advisor_id)
 
         subs_after = self.store.get_sub_train_jobs(job_id)

@@ -279,14 +279,16 @@ class MetaStore:
                      knobs: Dict[str, Any], worker_id: Optional[str] = None,
                      shape_sig: Optional[str] = None) -> dict:
         tid = _uid()
-        no = self._one(
-            "SELECT COUNT(*) AS n FROM trials WHERE sub_train_job_id=?",
-            (sub_train_job_id,))["n"] + 1
         with self._conn() as c:
+            # 'no' is assigned inside the INSERT's write transaction so
+            # concurrent workers can't get duplicate trial numbers.
             c.execute(
                 "INSERT INTO trials (id, sub_train_job_id, no, model_name, knobs, status,"
-                " worker_id, shape_sig, started_at, created_at) VALUES (?,?,?,?,?,?,?,?,?,?)",
-                (tid, sub_train_job_id, no, model_name, json.dumps(knobs),
+                " worker_id, shape_sig, started_at, created_at)"
+                " VALUES (?,?,"
+                "   (SELECT COUNT(*)+1 FROM trials WHERE sub_train_job_id=?),"
+                " ?,?,?,?,?,?,?)",
+                (tid, sub_train_job_id, sub_train_job_id, model_name, json.dumps(knobs),
                  TrialStatus.RUNNING.value, worker_id, shape_sig, _now(), _now()),
             )
         return self.get_trial(tid)

@@ -53,8 +53,8 @@ class InferenceWorker:
             self.bus.remove_worker(self.job_id, self.worker_id)
 
     def _predict(self, queries: List[Any]) -> List[Any]:
-        # Array fast path (classification): one stacked forward pass.
-        if hasattr(self.model, "predict_proba"):
-            x = np.asarray(queries, dtype=np.float32)
-            return self.model.predict_proba(x).tolist()
+        # Always the contract API: predict() owns query semantics
+        # (classification probs, tag sequences, ...). JaxModel.predict
+        # already batches the device forward internally, so the whole
+        # popped micro-batch still runs as one XLA program.
         return self.model.predict(queries)

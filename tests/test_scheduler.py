@@ -94,7 +94,9 @@ class Bad(BaseModel):
     store.create_sub_train_job(job["id"], bad["id"])
     sched = LocalScheduler(store, params)
     result = sched.run_train_job(job["id"], n_workers=2, advisor_kind="random")
-    assert result.status == "COMPLETED"  # job completes; trials errored
+    # The loop survives (containment) but the job is honestly ERRORED:
+    # every trial of its only model failed.
+    assert result.status == "ERRORED"
     assert len(result.trials) == 3
     assert all(t["status"] == "ERRORED" for t in result.trials)
     assert "bad" in (result.trials[0]["error"] or "")
