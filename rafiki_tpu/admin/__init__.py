@@ -1,0 +1,12 @@
+"""Control plane: admin business logic, services manager, REST app.
+
+Reference parity: rafiki/admin/ (unverified — SURVEY.md §1 L4):
+`Admin` business-logic class + Flask REST app + `ServicesManager`
+translating jobs into Docker Swarm services. Here the "services" are
+in-host threads/processes over the TPU chips — no containers needed.
+"""
+
+from rafiki_tpu.admin.admin import Admin
+from rafiki_tpu.admin.services_manager import ServicesManager
+
+__all__ = ["Admin", "ServicesManager"]

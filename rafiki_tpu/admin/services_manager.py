@@ -1,0 +1,239 @@
+"""Services manager: jobs → running services on the TPU host.
+
+Reference parity: rafiki/admin/services_manager.py (unverified —
+SURVEY.md §2): translates a train job into one advisor + N train-worker
+services and an inference job into one predictor + one inference worker
+per chosen trial, writing Service rows as it goes. The reference
+materialises services as Docker Swarm containers; here a "service" is a
+supervised thread (or, via ProcessScheduler, a subprocess pinned to a
+chip) on the TPU host — chips are a host-local resource, so container
+orchestration buys nothing and costs startup latency.
+
+Train jobs run asynchronously: ``create_train_services`` returns
+immediately and the scheduler drives the job to budget exhaustion in a
+background thread (stoppable via ``stop_train_services``).
+
+Inference jobs: per top-k trial, the trial's model class is re-loaded,
+its knobs re-applied and its trained parameters restored, then an
+InferenceWorker thread serves it off the bus; a Predictor fronts them
+(optionally over HTTP — see rafiki_tpu/predictor/app.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from rafiki_tpu.advisor import AdvisorService
+from rafiki_tpu.bus import InProcBus
+from rafiki_tpu.config import Config, get_config
+from rafiki_tpu.constants import (
+    InferenceJobStatus,
+    ServiceStatus,
+    ServiceType,
+    TrainJobStatus,
+)
+from rafiki_tpu.model.base import load_model_class
+from rafiki_tpu.predictor.predictor import Predictor
+from rafiki_tpu.scheduler.local import LocalScheduler
+from rafiki_tpu.store import MetaStore, ParamsStore
+from rafiki_tpu.worker.inference import InferenceWorker
+
+
+class _TrainJobHandle:
+    def __init__(self, thread: threading.Thread, stop_event: threading.Event):
+        self.thread = thread
+        self.stop_event = stop_event
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class _InferenceJobHandle:
+    def __init__(self):
+        self.stop_event = threading.Event()
+        self.worker_threads: List[threading.Thread] = []
+        self.workers: List[InferenceWorker] = []
+        self.predictor: Optional[Predictor] = None
+        self.http_server = None  # set when an HTTP frontend is attached
+
+
+class ServicesManager:
+    def __init__(self, store: MetaStore, params_store: ParamsStore,
+                 bus: Optional[InProcBus] = None,
+                 advisor_service: Optional[AdvisorService] = None,
+                 config: Optional[Config] = None):
+        self.store = store
+        self.params_store = params_store
+        self.bus = bus or InProcBus()
+        self.advisors = advisor_service or AdvisorService()
+        self.config = config or get_config()
+        self._train_jobs: Dict[str, _TrainJobHandle] = {}
+        self._inference_jobs: Dict[str, _InferenceJobHandle] = {}
+        self._lock = threading.Lock()
+
+    # -- train services ------------------------------------------------------
+
+    def create_train_services(self, job_id: str, n_workers: Optional[int] = None,
+                              devices: Optional[List[Any]] = None,
+                              devices_per_trial: int = 1,
+                              advisor_kind: str = "gp") -> None:
+        """Start the job's worker fleet in the background and return."""
+        with self._lock:
+            if job_id in self._train_jobs and self._train_jobs[job_id].thread.is_alive():
+                raise ValueError(f"Train job {job_id} already has running services")
+        scheduler = LocalScheduler(self.store, self.params_store, self.advisors)
+        stop_event = threading.Event()
+
+        def run():
+            try:
+                handle.result = scheduler.run_train_job(
+                    job_id, n_workers=n_workers, devices=devices,
+                    devices_per_trial=devices_per_trial,
+                    advisor_kind=advisor_kind, stop_event=stop_event)
+            except BaseException as e:  # surfaced via wait_train_job
+                handle.error = e
+                self.store.update_train_job_status(job_id, TrainJobStatus.ERRORED.value)
+
+        thread = threading.Thread(target=run, name=f"train-job-{job_id[:8]}", daemon=True)
+        handle = _TrainJobHandle(thread, stop_event)
+        with self._lock:
+            self._train_jobs[job_id] = handle
+        thread.start()
+
+    def stop_train_services(self, job_id: str, wait: bool = True,
+                            timeout: float = 60.0) -> None:
+        with self._lock:
+            handle = self._train_jobs.get(job_id)
+        if handle is None:
+            # No live services in this process (e.g. admin restarted):
+            # just mark the job stopped.
+            self.store.update_train_job_status(job_id, TrainJobStatus.STOPPED.value)
+            return
+        handle.stop_event.set()
+        if wait:
+            handle.thread.join(timeout=timeout)
+
+    def wait_train_job(self, job_id: str, timeout: Optional[float] = None):
+        """Block until the job's services finish; returns TrainJobResult
+        (None when the job already finished outside this process)."""
+        with self._lock:
+            handle = self._train_jobs.get(job_id)
+        if handle is None:
+            job = self.store.get_train_job(job_id)
+            if job is not None and job["status"] in (TrainJobStatus.STARTED.value,
+                                                     TrainJobStatus.RUNNING.value):
+                raise RuntimeError(
+                    f"Train job {job_id} is {job['status']} but has no services "
+                    "in this process (created with start=False, or the admin "
+                    "restarted); start it with create_train_services first")
+            return None
+        handle.thread.join(timeout=timeout)
+        if handle.thread.is_alive():
+            raise TimeoutError(f"Train job {job_id} still running after {timeout}s")
+        if handle.error is not None:
+            raise handle.error
+        return handle.result
+
+    # -- inference services --------------------------------------------------
+
+    def create_inference_services(self, inference_job_id: str,
+                                  best_trials: List[dict],
+                                  batch_size: Optional[int] = None) -> Predictor:
+        """One inference worker per trial + a predictor over the bus."""
+        if not best_trials:
+            raise ValueError("No completed trials to serve")
+        handle = _InferenceJobHandle()
+        batch_size = batch_size or self.config.inference_batch_size
+
+        for i, trial in enumerate(best_trials):
+            model = self._load_trial_model(trial)
+            worker_id = f"{inference_job_id[:8]}-iw{i}"
+            service = self.store.create_service(
+                ServiceType.INFERENCE_WORKER.value, job_id=inference_job_id,
+                worker_index=i)
+            worker = InferenceWorker(self.bus, inference_job_id, worker_id, model,
+                                     batch_size=batch_size,
+                                     stop_event=handle.stop_event)
+            th = threading.Thread(target=self._run_inference_worker,
+                                  args=(worker, service["id"]),
+                                  name=worker_id, daemon=True)
+            handle.workers.append(worker)
+            handle.worker_threads.append(th)
+
+        self.store.create_service(ServiceType.PREDICTOR.value, job_id=inference_job_id)
+        handle.predictor = Predictor(self.bus, inference_job_id,
+                                     timeout_s=self.config.predict_timeout_s)
+        for th in handle.worker_threads:
+            th.start()
+        # Wait for workers to register so the first query doesn't race them.
+        deadline = 5.0
+        import time
+        t0 = time.monotonic()
+        while (len(self.bus.get_workers(inference_job_id)) < len(best_trials)
+               and time.monotonic() - t0 < deadline):
+            time.sleep(0.01)
+        self.store.update_inference_job(inference_job_id,
+                                        status=InferenceJobStatus.RUNNING.value)
+        with self._lock:
+            self._inference_jobs[inference_job_id] = handle
+        return handle.predictor
+
+    def _run_inference_worker(self, worker: InferenceWorker, service_id: str) -> None:
+        self.store.update_service(service_id, status=ServiceStatus.RUNNING.value)
+        try:
+            worker.run()
+            self.store.update_service(service_id, status=ServiceStatus.STOPPED.value)
+        except Exception:
+            self.store.update_service(service_id, status=ServiceStatus.ERRORED.value)
+
+    def _load_trial_model(self, trial: dict):
+        """Rebuild a trained model from its trial row: class + knobs + params."""
+        sub = self.store.get_sub_train_job(trial["sub_train_job_id"])
+        if sub is None:  # data-integrity failure, not a caller mistake
+            raise RuntimeError(f"Trial {trial['id']} has no sub train job")
+        model_row = self.store.get_model(sub["model_id"])
+        model_cls = load_model_class(model_row["model_file"], model_row["model_class"])
+        model = model_cls(**trial["knobs"])
+        if trial.get("params_id"):
+            model.load_parameters(self.params_store.load(trial["params_id"]))
+        return model
+
+    def get_predictor(self, inference_job_id: str) -> Optional[Predictor]:
+        with self._lock:
+            handle = self._inference_jobs.get(inference_job_id)
+        return handle.predictor if handle else None
+
+    def attach_http_server(self, inference_job_id: str, server) -> None:
+        with self._lock:
+            handle = self._inference_jobs.get(inference_job_id)
+        if handle is not None:
+            handle.http_server = server
+
+    def stop_inference_services(self, inference_job_id: str,
+                                timeout: float = 10.0) -> None:
+        with self._lock:
+            handle = self._inference_jobs.pop(inference_job_id, None)
+        if handle is None:
+            self.store.update_inference_job(inference_job_id,
+                                            status=InferenceJobStatus.STOPPED.value)
+            return
+        handle.stop_event.set()
+        for th in handle.worker_threads:
+            th.join(timeout=timeout)
+        if handle.http_server is not None:
+            handle.http_server.shutdown()
+        self.store.update_inference_job(inference_job_id,
+                                        status=InferenceJobStatus.STOPPED.value)
+
+    # -- teardown ------------------------------------------------------------
+
+    def stop_all(self) -> None:
+        with self._lock:
+            train_ids = list(self._train_jobs)
+            inf_ids = list(self._inference_jobs)
+        for jid in train_ids:
+            self.stop_train_services(jid, wait=False)
+        for jid in inf_ids:
+            self.stop_inference_services(jid)
+        for jid in train_ids:
+            self.stop_train_services(jid, wait=True)

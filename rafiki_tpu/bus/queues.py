@@ -23,7 +23,12 @@ class InProcBus:
     _EXPIRED_CAP = 4096  # remembered timed-out query ids (leak guard)
 
     def __init__(self):
-        self._queues: Dict[str, queue.Queue] = defaultdict(queue.Queue)
+        # Queues exist exactly while their worker is registered:
+        # created in add_worker, destroyed in remove_worker, and
+        # add_query drops (rather than resurrects) queries to dead
+        # workers — otherwise repeated inference-job cycles would leak
+        # one queue per retired worker id.
+        self._queues: Dict[str, queue.Queue] = {}
         self._preds: Dict[str, list] = {}
         self._pred_cv = threading.Condition()
         self._workers: Dict[str, set] = defaultdict(set)
@@ -36,11 +41,12 @@ class InProcBus:
     def add_worker(self, job_id: str, worker_id: str) -> None:
         with self._lock:
             self._workers[job_id].add(worker_id)
+            self._queues.setdefault(worker_id, queue.Queue())
 
     def remove_worker(self, job_id: str, worker_id: str) -> None:
         with self._lock:
             self._workers[job_id].discard(worker_id)
-        self._queues.pop(worker_id, None)  # drop the dead worker's queue
+            self._queues.pop(worker_id, None)
 
     def get_workers(self, job_id: str) -> List[str]:
         with self._lock:
@@ -49,13 +55,20 @@ class InProcBus:
     # -- queries -------------------------------------------------------------
 
     def add_query(self, worker_id: str, query_id: str, query: Any) -> None:
-        self._queues[worker_id].put((query_id, query))
+        with self._lock:
+            q = self._queues.get(worker_id)
+        if q is not None:  # dead worker → drop; the gather just sees n-1
+            q.put((query_id, query))
 
     def pop_queries(self, worker_id: str, max_n: int = 64,
                     timeout: float = 0.1) -> List[Tuple[str, Any]]:
         """Block up to ``timeout`` for the first query, then drain up to
         max_n without blocking — natural micro-batching for the device."""
-        q = self._queues[worker_id]
+        with self._lock:
+            q = self._queues.get(worker_id)
+        if q is None:  # not registered (stopped): nothing to serve
+            time.sleep(min(timeout, 0.05))
+            return []
         out: List[Tuple[str, Any]] = []
         try:
             out.append(q.get(timeout=timeout))

@@ -1,0 +1,8 @@
+"""Client SDK for the rafiki-tpu control plane.
+
+Reference parity: rafiki/client/ (unverified — SURVEY.md §1 L7).
+"""
+
+from rafiki_tpu.client.client import Client, ClientError
+
+__all__ = ["Client", "ClientError"]

@@ -1,0 +1,180 @@
+"""Client: the user-facing SDK over the admin REST API.
+
+Reference parity: rafiki/client/client.py (unverified — SURVEY.md §2):
+`Client` with login, create_user, create_model (uploads the model .py),
+create_train_job, get_train_job, get_best_trials_of_train_job,
+get_trial_logs, create_inference_job, stop_* — same verb names here so
+reference user scripts translate 1:1.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import requests
+
+
+class ClientError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class Client:
+    def __init__(self, admin_host: str = "127.0.0.1", admin_port: int = 3000):
+        self._base = f"http://{admin_host}:{admin_port}"
+        self._token: Optional[str] = None
+        self._session = requests.Session()
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _headers(self) -> Dict[str, str]:
+        return {"Authorization": f"Bearer {self._token}"} if self._token else {}
+
+    def _request(self, method: str, path: str, **kwargs) -> Any:
+        resp = self._session.request(method, self._base + path,
+                                     headers=self._headers(), **kwargs)
+        if resp.status_code >= 400:
+            try:
+                message = resp.json().get("error", resp.text)
+            except (ValueError, AttributeError):
+                message = resp.text
+            raise ClientError(resp.status_code, message)
+        ctype = resp.headers.get("Content-Type", "")
+        return resp.json() if "json" in ctype else resp.content
+
+    def _get(self, path: str, params: Optional[dict] = None) -> Any:
+        return self._request("GET", path, params=params)
+
+    def _post(self, path: str, body: Optional[dict] = None,
+              files: Optional[dict] = None, data: Optional[dict] = None) -> Any:
+        if files is not None:
+            return self._request("POST", path, files=files, data=data)
+        return self._request("POST", path, json=body or {})
+
+    # -- auth / users --------------------------------------------------------
+
+    def login(self, email: str, password: str) -> Dict[str, Any]:
+        out = self._post("/tokens", {"email": email, "password": password})
+        self._token = out["token"]
+        return out
+
+    def logout(self) -> None:
+        self._token = None
+
+    def create_user(self, email: str, password: str, user_type: str) -> dict:
+        return self._post("/users", {"email": email, "password": password,
+                                     "user_type": user_type})
+
+    def get_users(self) -> List[dict]:
+        return self._get("/users")
+
+    def ban_user(self, email: str) -> dict:
+        return self._request("DELETE", "/users", json={"email": email})
+
+    # -- models --------------------------------------------------------------
+
+    def create_model(self, name: str, task: str, model_file_path: str | Path,
+                     model_class: str, dependencies: Optional[dict] = None,
+                     access_right: str = "PRIVATE", docs: str = "") -> dict:
+        """Upload a model template .py (multipart, like the reference)."""
+        with open(model_file_path, "rb") as f:
+            return self._post(
+                "/models",
+                files={"model_file": (Path(model_file_path).name, f)},
+                data={"name": name, "task": task, "model_class": model_class,
+                      "dependencies": json.dumps(dependencies or {}),
+                      "access_right": access_right, "docs": docs})
+
+    def get_models(self, task: Optional[str] = None) -> List[dict]:
+        return self._get("/models", params={"task": task} if task else None)
+
+    def get_model(self, name: str) -> dict:
+        return self._get(f"/models/{name}")
+
+    def download_model_file(self, name: str) -> bytes:
+        return self._get(f"/models/{name}/file")
+
+    # -- train jobs ----------------------------------------------------------
+
+    def create_train_job(self, app: str, task: str, train_dataset_uri: str,
+                         val_dataset_uri: str, budget: Dict[str, Any],
+                         model_names: Optional[List[str]] = None,
+                         advisor_kind: str = "gp",
+                         devices_per_trial: int = 1) -> dict:
+        return self._post("/train_jobs", {
+            "app": app, "task": task, "train_dataset_uri": train_dataset_uri,
+            "val_dataset_uri": val_dataset_uri, "budget": budget,
+            "model_names": model_names, "advisor_kind": advisor_kind,
+            "devices_per_trial": devices_per_trial})
+
+    def get_train_jobs(self) -> List[dict]:
+        return self._get("/train_jobs")
+
+    def _vpath(self, prefix: str, app: str, app_version: int, suffix: str = "") -> str:
+        """-1 (or 0) means "latest version" — the server resolves it."""
+        if app_version > 0:
+            return f"{prefix}/{app}/{app_version}{suffix}"
+        return f"{prefix}/{app}{suffix}"
+
+    def get_train_job(self, app: str, app_version: int = -1) -> dict:
+        return self._get(self._vpath("/train_jobs", app, app_version))
+
+    def stop_train_job(self, app: str, app_version: int = -1) -> dict:
+        return self._post(self._vpath("/train_jobs", app, app_version, "/stop"))
+
+    def wait_until_train_job_has_stopped(self, app: str, app_version: int = -1,
+                                         timeout: float = 3600.0,
+                                         poll_s: float = 1.0) -> dict:
+        """Poll until the job leaves STARTED/RUNNING (reference clients
+        poll the same way)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            job = self.get_train_job(app, app_version)
+            if job["status"] not in ("STARTED", "RUNNING"):
+                return job
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"Train job {app} still {job['status']}")
+            time.sleep(poll_s)
+
+    # -- trials --------------------------------------------------------------
+
+    def get_trials_of_train_job(self, app: str, app_version: int = -1) -> List[dict]:
+        return self._get(self._vpath("/train_jobs", app, app_version, "/trials"))
+
+    def get_best_trials_of_train_job(self, app: str, app_version: int = -1,
+                                     max_count: int = 2) -> List[dict]:
+        return self._get(self._vpath("/train_jobs", app, app_version, "/trials"),
+                         params={"type": "best", "max_count": max_count})
+
+    def get_trial(self, trial_id: str) -> dict:
+        return self._get(f"/trials/{trial_id}")
+
+    def get_trial_logs(self, trial_id: str) -> List[dict]:
+        return self._get(f"/trials/{trial_id}/logs")
+
+    def get_trial_parameters(self, trial_id: str) -> bytes:
+        return self._get(f"/trials/{trial_id}/parameters")
+
+    # -- inference jobs ------------------------------------------------------
+
+    def create_inference_job(self, app: str, app_version: int = -1,
+                             max_models: int = 2) -> dict:
+        return self._post("/inference_jobs", {"app": app, "app_version": app_version,
+                                              "max_models": max_models})
+
+    def get_inference_job(self, app: str, app_version: int = -1) -> dict:
+        return self._get(self._vpath("/inference_jobs", app, app_version))
+
+    def stop_inference_job(self, app: str, app_version: int = -1) -> dict:
+        return self._post(self._vpath("/inference_jobs", app, app_version, "/stop"))
+
+    def predict(self, app: str, queries: List[Any],
+                app_version: int = -1) -> List[Any]:
+        out = self._post(f"/predict/{app}",
+                         {"queries": queries, "app_version": app_version})
+        return out["predictions"]

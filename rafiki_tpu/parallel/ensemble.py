@@ -44,13 +44,18 @@ def make_ensemble_forward(apply_fn, mesh: Optional[Mesh] = None):
     # over "model". Under shard_map each chip vmaps over its local k/n
     # sub-ensemble with ordinary convs — embarrassingly parallel, no
     # collectives until the host gathers the output.
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.8 (check_rep renamed check_vma)
+        kw = {"check_vma": False}
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
+        kw = {"check_rep": False}
 
     body = shard_map(
         fwd, mesh=mesh,
         in_specs=(P("model"), P()),
         out_specs=P("model"),
-        check_rep=False,
+        **kw,
     )
     return jax.jit(body)
 

@@ -34,11 +34,14 @@ class Predictor:
                 self.bus.add_query(w, qid, query)
         # One deadline for the whole batch: a dead-but-registered worker
         # costs at most timeout_s total, not timeout_s per query, and
-        # partial gathers still ensemble whatever arrived.
+        # partial gathers still ensemble whatever arrived. Past the
+        # deadline, remaining queries gather non-blockingly (timeout 0)
+        # so batch latency stays bounded by timeout_s regardless of
+        # batch size.
         deadline = time.monotonic() + self.timeout_s
         out: List[Any] = []
         for qid in qids:
-            remaining = max(0.05, deadline - time.monotonic())
+            remaining = max(0.0, deadline - time.monotonic())
             preds = self.bus.get_predictions(qid, n=len(workers), timeout=remaining)
             if not preds:
                 out.append({"error": "prediction timeout"})

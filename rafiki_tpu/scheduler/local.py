@@ -16,7 +16,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from rafiki_tpu.advisor import AdvisorService
-from rafiki_tpu.constants import ServiceType, TrainJobStatus
+from rafiki_tpu.constants import ServiceStatus, ServiceType, TrainJobStatus, TrialStatus
 from rafiki_tpu.model.base import load_model_class
 from rafiki_tpu.parallel.mesh import local_devices, partition_devices
 from rafiki_tpu.store import MetaStore, ParamsStore
@@ -115,17 +115,17 @@ class LocalScheduler:
                                       name=f"train-worker-{i}", daemon=True)
                 threads.append(th)
             for svc in services:
-                self.store.update_service(svc["id"], status="RUNNING")
+                self.store.update_service(svc["id"], status=ServiceStatus.RUNNING.value)
             for th in threads:
                 th.start()
             for th in threads:
                 th.join()
             for svc in services:
-                self.store.update_service(svc["id"], status="STOPPED")
+                self.store.update_service(svc["id"], status=ServiceStatus.STOPPED.value)
             trials = self.store.get_trials_of_sub_train_job(sub["id"])
             if stop_event.is_set():
                 sub_status = TrainJobStatus.STOPPED.value
-            elif trials and all(t["status"] == "ERRORED" for t in trials):
+            elif trials and all(t["status"] == TrialStatus.ERRORED.value for t in trials):
                 sub_status = TrainJobStatus.ERRORED.value
             else:
                 sub_status = TrainJobStatus.COMPLETED.value

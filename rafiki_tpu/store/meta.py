@@ -169,6 +169,10 @@ class MetaStore:
         m = self._one("SELECT * FROM models WHERE name=? ORDER BY created_at DESC", (name,))
         return self._load_model_row(m)
 
+    def get_models(self) -> List[dict]:
+        return [self._load_model_row(m) for m in
+                self._all("SELECT * FROM models ORDER BY created_at")]
+
     def get_models_of_task(self, task: str) -> List[dict]:
         return [self._load_model_row(m) for m in
                 self._all("SELECT * FROM models WHERE task=? ORDER BY created_at", (task,))]
@@ -208,11 +212,16 @@ class MetaStore:
 
     def get_train_job_by_app(self, app: str, app_version: int = -1,
                              user_id: Optional[str] = None) -> Optional[dict]:
+        """``user_id`` scopes the lookup to that user's jobs (pass None
+        for an unscoped/admin lookup)."""
         q = "SELECT * FROM train_jobs WHERE app=?"
         args: list = [app]
         if app_version > 0:
             q += " AND app_version=?"
             args.append(app_version)
+        if user_id is not None:
+            q += " AND user_id=?"
+            args.append(user_id)
         q += " ORDER BY app_version DESC"
         j = self._one(q, tuple(args))
         if j:
@@ -250,6 +259,9 @@ class MetaStore:
                  advisor_id, _now()),
             )
         return self._one("SELECT * FROM sub_train_jobs WHERE id=?", (sid,))
+
+    def get_sub_train_job(self, sub_id: str) -> Optional[dict]:
+        return self._one("SELECT * FROM sub_train_jobs WHERE id=?", (sub_id,))
 
     def get_sub_train_jobs(self, train_job_id: str) -> List[dict]:
         return self._all("SELECT * FROM sub_train_jobs WHERE train_job_id=?", (train_job_id,))

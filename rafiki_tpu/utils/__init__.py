@@ -1,0 +1,5 @@
+"""Cross-cutting utilities: auth (JWT + password hashing), misc.
+
+Reference parity: rafiki/utils/ (unverified — SURVEY.md §1 cross-cutting
+row): JWT auth decorator, logging, parsing helpers.
+"""

@@ -1,0 +1,135 @@
+"""Admin business logic: full in-process AutoML lifecycle (no HTTP)."""
+
+import pytest
+
+from rafiki_tpu.admin import Admin
+from rafiki_tpu.utils.auth import AuthError
+
+FF_SOURCE = b"""
+from rafiki_tpu.model.base import JaxModel
+from rafiki_tpu.model.knobs import CategoricalKnob, FixedKnob, FloatKnob
+
+class TinyFF(JaxModel):
+    @staticmethod
+    def get_knob_config():
+        return {
+            "hidden_units": CategoricalKnob([16, 32], affects_shape=True),
+            "learning_rate": FloatKnob(1e-3, 3e-2, is_exp=True),
+            "batch_size": FixedKnob(32),
+            "epochs": FixedKnob(1),
+        }
+
+    def build_module(self, num_classes, input_shape):
+        from rafiki_tpu.models.ff import _Mlp
+        return _Mlp(hidden_layers=1, hidden_units=int(self.knobs["hidden_units"]),
+                    num_classes=num_classes)
+"""
+
+TRAIN = "synthetic://images?classes=5&n=256&w=8&h=8&seed=0"
+VAL = "synthetic://images?classes=5&n=128&w=8&h=8&seed=1"
+
+
+@pytest.fixture()
+def admin(tmp_config):
+    a = Admin(config=tmp_config)
+    yield a
+    a.stop()
+
+
+def test_superadmin_seeded_and_login(admin, tmp_config):
+    out = admin.authenticate_user(tmp_config.superadmin_email,
+                                  tmp_config.superadmin_password)
+    assert out["user_type"] == "SUPERADMIN"
+    assert out["token"]
+    with pytest.raises(AuthError):
+        admin.authenticate_user(tmp_config.superadmin_email, "wrong")
+
+
+def test_user_lifecycle(admin):
+    u = admin.create_user("dev@x", "pw", "MODEL_DEVELOPER")
+    assert u["user_type"] == "MODEL_DEVELOPER"
+    with pytest.raises(ValueError):
+        admin.create_user("dev@x", "pw", "MODEL_DEVELOPER")  # duplicate
+    with pytest.raises(ValueError):
+        admin.create_user("z@x", "pw", "WIZARD")  # bad role
+    admin.ban_user("dev@x")
+    with pytest.raises(AuthError, match="banned"):
+        admin.authenticate_user("dev@x", "pw")
+
+
+def test_model_upload_validation(admin):
+    with pytest.raises(ValueError, match="Invalid model template"):
+        admin.create_model(None, "bad", "IMAGE_CLASSIFICATION",
+                           b"this is not python ][", "Nope")
+    m = admin.create_model(None, "tinyff", "IMAGE_CLASSIFICATION",
+                           FF_SOURCE, "TinyFF")
+    assert m["name"] == "tinyff"
+    assert admin.get_model("tinyff")["model_class"] == "TinyFF"
+    assert admin.get_model_file("tinyff") == FF_SOURCE
+
+
+def test_train_job_budget_validation(admin):
+    admin.create_model(None, "tinyff", "IMAGE_CLASSIFICATION", FF_SOURCE, "TinyFF")
+    with pytest.raises(ValueError, match="[Bb]udget"):
+        admin.create_train_job(None, "app", "IMAGE_CLASSIFICATION", TRAIN, VAL, {},
+                               start=False)
+    with pytest.raises(ValueError, match="Unknown budget keys"):
+        admin.create_train_job(None, "app", "IMAGE_CLASSIFICATION", TRAIN, VAL,
+                               {"COFFEE_COUNT": 3}, start=False)
+    with pytest.raises(ValueError, match="No models"):
+        admin.create_train_job(None, "app", "POS_TAGGING", TRAIN, VAL,
+                               {"MODEL_TRIAL_COUNT": 1}, start=False)
+
+
+def test_full_automl_lifecycle(admin):
+    """Train → best trials → inference job → predict → stop. The whole
+    reference user journey (SURVEY.md §3.1–3.2) in one process."""
+    admin.create_model(None, "tinyff", "IMAGE_CLASSIFICATION", FF_SOURCE, "TinyFF")
+    job = admin.create_train_job(None, "myapp", "IMAGE_CLASSIFICATION",
+                                 TRAIN, VAL, {"MODEL_TRIAL_COUNT": 3},
+                                 advisor_kind="random")
+    assert job["app_version"] == 1
+    done = admin.wait_train_job("myapp", timeout=300)
+    assert done["status"] == "COMPLETED"
+
+    trials = admin.get_trials_of_train_job("myapp")
+    assert len(trials) == 3
+    best = admin.get_best_trials_of_train_job("myapp", max_count=2)
+    assert len(best) == 2
+    assert best[0]["score"] >= best[1]["score"]
+    assert admin.get_trial(best[0]["id"])["status"] == "COMPLETED"
+    assert len(admin.get_trial_parameters(best[0]["id"])) > 100
+    logs = admin.get_trial_logs(best[0]["id"])
+    assert any("loss" in str(e) or "epoch" in str(e) for e in logs)
+
+    # premature inference job on a second app fails cleanly
+    with pytest.raises(KeyError):
+        admin.create_inference_job(None, "nosuchapp")
+
+    inf = admin.create_inference_job(None, "myapp")
+    assert inf["status"] == "RUNNING"
+    import numpy as np
+    queries = np.random.default_rng(0).uniform(0, 1, size=(4, 8, 8, 1)).tolist()
+    preds = admin.predict("myapp", queries)
+    assert len(preds) == 4
+    assert all(len(p) == 5 for p in preds)          # 5-class prob vectors
+    assert abs(sum(preds[0]) - 1.0) < 1e-3
+
+    with pytest.raises(ValueError, match="already has a running inference job"):
+        admin.create_inference_job(None, "myapp")
+
+    admin.stop_inference_job("myapp")
+    with pytest.raises(KeyError):
+        admin.get_inference_job("myapp")
+
+
+def test_stop_train_job(admin):
+    admin.create_model(None, "tinyff", "IMAGE_CLASSIFICATION", FF_SOURCE, "TinyFF")
+    admin.create_train_job(None, "stopapp", "IMAGE_CLASSIFICATION", TRAIN, VAL,
+                           {"MODEL_TRIAL_COUNT": 50}, advisor_kind="random")
+    out = admin.stop_train_job("stopapp")
+    assert out["status"] in ("STOPPED", "COMPLETED")
+    job = admin.wait_train_job("stopapp", timeout=60)
+    assert job["status"] in ("STOPPED", "COMPLETED")
+    # far fewer than 50 trials actually ran
+    assert len(admin.get_trials_of_train_job("stopapp")) < 50

@@ -1,0 +1,65 @@
+"""Auth unit tests: password hashing, JWT round-trips, role checks."""
+
+import time
+
+import pytest
+
+from rafiki_tpu.utils.auth import (
+    AuthError,
+    check_user_type,
+    decode_token,
+    generate_token,
+    hash_password,
+    verify_password,
+)
+
+
+def test_password_hash_roundtrip():
+    stored = hash_password("hunter2")
+    assert verify_password("hunter2", stored)
+    assert not verify_password("hunter3", stored)
+    assert stored != hash_password("hunter2")  # fresh salt every time
+
+
+def test_password_bad_format():
+    assert not verify_password("x", "not-a-hash")
+    assert not verify_password("x", "")
+
+
+def test_jwt_roundtrip():
+    token = generate_token({"user_id": "u1", "user_type": "ADMIN"}, "secret")
+    payload = decode_token(token, "secret")
+    assert payload["user_id"] == "u1"
+    assert payload["user_type"] == "ADMIN"
+
+
+def test_jwt_bad_signature():
+    token = generate_token({"user_id": "u1"}, "secret")
+    with pytest.raises(AuthError):
+        decode_token(token, "other-secret")
+    with pytest.raises(AuthError):
+        decode_token(token[:-4] + "AAAA", "secret")
+
+
+def test_jwt_expiry():
+    token = generate_token({"user_id": "u1"}, "s", ttl_s=-1)
+    with pytest.raises(AuthError, match="expired"):
+        decode_token(token, "s")
+    token = generate_token({"user_id": "u1"}, "s", ttl_s=60)
+    assert decode_token(token, "s")["user_id"] == "u1"
+
+
+def test_jwt_malformed():
+    for bad in ("", "abc", "a.b", "a.b.c"):
+        with pytest.raises(AuthError):
+            decode_token(bad, "s")
+
+
+def test_role_ladder():
+    check_user_type("MODEL_DEVELOPER", ["MODEL_DEVELOPER"])
+    check_user_type("ADMIN", ["MODEL_DEVELOPER"])       # admins can do anything
+    check_user_type("SUPERADMIN", ["APP_DEVELOPER"])
+    with pytest.raises(AuthError):
+        check_user_type("APP_DEVELOPER", ["MODEL_DEVELOPER"])
+    with pytest.raises(AuthError):
+        check_user_type("", ["ADMIN"])

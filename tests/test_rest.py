@@ -1,0 +1,166 @@
+"""REST integration: real HTTP server + Client SDK, full user journey.
+
+This is the rebuild's analog of the reference's quickstart scripts
+(SURVEY.md §4 "quickstart scripts as integration tests") — but runnable
+under pytest against the fake 8-chip CPU pod.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+from werkzeug.serving import make_server
+
+from rafiki_tpu.admin import Admin
+from rafiki_tpu.admin.app import AdminApp
+from rafiki_tpu.client import Client, ClientError
+
+from tests.test_admin import FF_SOURCE, TRAIN, VAL
+
+
+@pytest.fixture()
+def server(tmp_config):
+    admin = Admin(config=tmp_config)
+    app = AdminApp(admin)
+    srv = make_server("127.0.0.1", 0, app, threaded=True)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv.server_port
+    srv.shutdown()
+    thread.join(timeout=10)
+    admin.stop()
+
+
+@pytest.fixture()
+def superadmin(server, tmp_config):
+    c = Client(admin_port=server)
+    c.login(tmp_config.superadmin_email, tmp_config.superadmin_password)
+    return c
+
+
+def test_login_and_auth_required(server, tmp_config):
+    c = Client(admin_port=server)
+    with pytest.raises(ClientError) as e:
+        c.get_models()
+    assert e.value.status == 401
+    with pytest.raises(ClientError) as e:
+        c.login(tmp_config.superadmin_email, "wrong")
+    assert e.value.status == 401
+    out = c.login(tmp_config.superadmin_email, tmp_config.superadmin_password)
+    assert out["user_type"] == "SUPERADMIN"
+    assert c.get_models() == []
+
+
+def test_role_enforcement(server, superadmin):
+    superadmin.create_user("app@x", "pw", "APP_DEVELOPER")
+    c = Client(admin_port=server)
+    c.login("app@x", "pw")
+    with pytest.raises(ClientError) as e:
+        c.create_user("other@x", "pw", "APP_DEVELOPER")  # app dev can't mint users
+    assert e.value.status == 401
+    with pytest.raises(ClientError) as e:
+        c.get_users()
+    assert e.value.status == 401
+
+
+def test_rest_full_journey(server, superadmin, tmp_path):
+    """create users → upload model (multipart) → train job → poll →
+    best trials → logs → inference job → predict over HTTP → stop."""
+    superadmin.create_user("modeldev@x", "pw", "MODEL_DEVELOPER")
+    superadmin.create_user("appdev@x", "pw", "APP_DEVELOPER")
+
+    dev = Client(admin_port=server)
+    dev.login("modeldev@x", "pw")
+    model_path = tmp_path / "tinyff.py"
+    model_path.write_bytes(FF_SOURCE)
+    m = dev.create_model("tinyff", "IMAGE_CLASSIFICATION", model_path, "TinyFF")
+    assert m["name"] == "tinyff"
+    assert dev.download_model_file("tinyff") == FF_SOURCE
+
+    appdev = Client(admin_port=server)
+    appdev.login("appdev@x", "pw")
+    job = appdev.create_train_job(
+        "restapp", "IMAGE_CLASSIFICATION", TRAIN, VAL,
+        {"MODEL_TRIAL_COUNT": 3}, advisor_kind="random")
+    assert job["status"] == "STARTED"
+
+    done = appdev.wait_until_train_job_has_stopped("restapp", timeout=300,
+                                                   poll_s=0.5)
+    assert done["status"] == "COMPLETED"
+    trials = appdev.get_trials_of_train_job("restapp")
+    assert len(trials) == 3
+    best = appdev.get_best_trials_of_train_job("restapp", max_count=2)
+    assert best and best[0]["score"] is not None
+    assert isinstance(appdev.get_trial_logs(best[0]["id"]), list)
+    assert len(appdev.get_trial_parameters(best[0]["id"])) > 100
+
+    appdev.create_inference_job("restapp")
+    queries = np.random.default_rng(0).uniform(0, 1, size=(2, 8, 8, 1)).tolist()
+    preds = appdev.predict("restapp", queries)
+    assert len(preds) == 2 and abs(sum(preds[0]) - 1.0) < 1e-3
+
+    appdev.stop_inference_job("restapp")
+    with pytest.raises(ClientError) as e:
+        appdev.get_inference_job("restapp")
+    assert e.value.status == 404
+
+
+def test_private_model_file_access(server, superadmin, tmp_path):
+    superadmin.create_user("owner@x", "pw", "MODEL_DEVELOPER")
+    superadmin.create_user("other@x", "pw", "MODEL_DEVELOPER")
+    owner = Client(admin_port=server)
+    owner.login("owner@x", "pw")
+    path = tmp_path / "m.py"
+    path.write_bytes(FF_SOURCE)
+    owner.create_model("privm", "IMAGE_CLASSIFICATION", path, "TinyFF",
+                       access_right="PRIVATE")
+    assert owner.download_model_file("privm") == FF_SOURCE     # owner OK
+    assert superadmin.download_model_file("privm") == FF_SOURCE  # admin OK
+    other = Client(admin_port=server)
+    other.login("other@x", "pw")
+    with pytest.raises(ClientError) as e:
+        other.download_model_file("privm")                     # stranger blocked
+    assert e.value.status == 401
+
+
+def test_missing_field_is_400(server, superadmin):
+    with pytest.raises(ClientError) as e:
+        superadmin._post("/users", {"email": "nopw@x"})  # no password/user_type
+    assert e.value.status == 400
+    assert "password" in e.value.message
+
+
+def test_stop_scoped_to_owner(server, superadmin):
+    """An app developer cannot stop another developer's train job."""
+    superadmin.create_user("dev1@x", "pw", "MODEL_DEVELOPER")
+    superadmin.create_user("a1@x", "pw", "APP_DEVELOPER")
+    superadmin.create_user("a2@x", "pw", "APP_DEVELOPER")
+    import tempfile
+    from pathlib import Path
+    dev = Client(admin_port=server)
+    dev.login("dev1@x", "pw")
+    with tempfile.TemporaryDirectory() as d:
+        p = Path(d) / "m.py"
+        p.write_bytes(FF_SOURCE)
+        dev.create_model("scopeff", "IMAGE_CLASSIFICATION", p, "TinyFF")
+    a1 = Client(admin_port=server)
+    a1.login("a1@x", "pw")
+    a1.create_train_job("scopedapp", "IMAGE_CLASSIFICATION", TRAIN, VAL,
+                        {"MODEL_TRIAL_COUNT": 20}, advisor_kind="random")
+    a2 = Client(admin_port=server)
+    a2.login("a2@x", "pw")
+    with pytest.raises(ClientError) as e:
+        a2.stop_train_job("scopedapp")  # not a2's job → 404, still running
+    assert e.value.status == 404
+    out = a1.stop_train_job("scopedapp")
+    assert out["status"] in ("STOPPED", "COMPLETED", "RUNNING", "STARTED")
+    a1.wait_until_train_job_has_stopped("scopedapp", timeout=120, poll_s=0.5)
+
+
+def test_404s(server, superadmin):
+    with pytest.raises(ClientError) as e:
+        superadmin.get_model("ghost")
+    assert e.value.status == 404
+    with pytest.raises(ClientError) as e:
+        superadmin.get_train_job("ghost")
+    assert e.value.status == 404
