@@ -47,6 +47,7 @@ from werkzeug.wrappers import Request, Response
 from rafiki_tpu.admin.admin import Admin, NotFoundError
 from rafiki_tpu.constants import UserType
 from rafiki_tpu.utils.auth import AuthError, check_user_type, decode_token
+from rafiki_tpu.utils.jsonable import jsonable as _jsonable
 
 _WEB_DIR = Path(__file__).resolve().parent.parent / "web"
 
@@ -305,30 +306,22 @@ class AdminApp:
 
     def ep_advisor_propose(self, request: Request, advisor_id: str) -> Response:
         self._auth(request)
-        return _json({"knobs": self.admin.services.advisors.propose(advisor_id)})
+        try:
+            knobs = self.admin.services.advisors.propose(advisor_id)
+        except KeyError:
+            raise NotFoundError(f"No advisor {advisor_id!r}")
+        return _json({"knobs": knobs})
 
     def ep_advisor_feedback(self, request: Request, advisor_id: str) -> Response:
         self._auth(request)
         body = self._body(request)
-        self.admin.services.advisors.feedback(
-            advisor_id, float(self._field(body, "score")),
-            self._field(body, "knobs"))
+        try:
+            self.admin.services.advisors.feedback(
+                advisor_id, float(self._field(body, "score")),
+                self._field(body, "knobs"))
+        except KeyError:
+            raise NotFoundError(f"No advisor {advisor_id!r}")
         return _json({"ok": True})
-
-
-def _jsonable(obj: Any) -> Any:
-    """Numpy arrays/scalars → lists/floats so responses serialize."""
-    import numpy as np
-
-    if isinstance(obj, np.ndarray):
-        return obj.tolist()
-    if isinstance(obj, (np.floating, np.integer)):
-        return obj.item()
-    if isinstance(obj, dict):
-        return {k: _jsonable(v) for k, v in obj.items()}
-    if isinstance(obj, (list, tuple)):
-        return [_jsonable(v) for v in obj]
-    return obj
 
 
 def make_admin_app(admin: Optional[Admin] = None) -> AdminApp:

@@ -106,8 +106,12 @@ class ServicesManager:
             handle = self._train_jobs.get(job_id)
         if handle is None:
             # No live services in this process (e.g. admin restarted):
-            # just mark the job stopped.
-            self.store.update_train_job_status(job_id, TrainJobStatus.STOPPED.value)
+            # mark the job stopped — but never clobber a terminal state.
+            job = self.store.get_train_job(job_id)
+            if job is not None and job["status"] in (TrainJobStatus.STARTED.value,
+                                                     TrainJobStatus.RUNNING.value):
+                self.store.update_train_job_status(job_id,
+                                                   TrainJobStatus.STOPPED.value)
             return
         handle.stop_event.set()
         if wait:
@@ -138,13 +142,33 @@ class ServicesManager:
 
     def create_inference_services(self, inference_job_id: str,
                                   best_trials: List[dict],
-                                  batch_size: Optional[int] = None) -> Predictor:
-        """One inference worker per trial + a predictor over the bus."""
+                                  batch_size: Optional[int] = None,
+                                  serve_http: bool = True) -> Predictor:
+        """One inference worker per trial + a predictor over the bus,
+        plus (by default) a published HTTP frontend whose host:port is
+        recorded on the inference-job row — the reference's per-job
+        predictor port."""
         if not best_trials:
             raise ValueError("No completed trials to serve")
         handle = _InferenceJobHandle()
         batch_size = batch_size or self.config.inference_batch_size
+        try:
+            return self._start_inference(handle, inference_job_id, best_trials,
+                                         batch_size, serve_http)
+        except Exception:
+            # Tear down whatever already started — otherwise worker
+            # threads (each pinning a trained model) leak unreachably.
+            handle.stop_event.set()
+            for th in handle.worker_threads:
+                th.join(timeout=5)
+            if handle.http_server is not None:
+                handle.http_server.shutdown()
+                handle.http_server.server_close()
+            raise
 
+    def _start_inference(self, handle: "_InferenceJobHandle",
+                         inference_job_id: str, best_trials: List[dict],
+                         batch_size: int, serve_http: bool) -> Predictor:
         for i, trial in enumerate(best_trials):
             model = self._load_trial_model(trial)
             worker_id = f"{inference_job_id[:8]}-iw{i}"
@@ -172,8 +196,26 @@ class ServicesManager:
         while (len(self.bus.get_workers(inference_job_id)) < len(best_trials)
                and time.monotonic() - t0 < deadline):
             time.sleep(0.01)
+        predictor_host = None
+        if serve_http:
+            from rafiki_tpu.predictor.app import start_predictor_server
+
+            handle.http_server, predictor_host = start_predictor_server(
+                handle.predictor, host=self.config.admin_host)
+            # A wildcard bind address is unroutable for clients: advertise
+            # a reachable address instead.
+            bind_host, _, port = predictor_host.rpartition(":")
+            if bind_host in ("0.0.0.0", "::", ""):
+                import socket
+
+                try:
+                    advertise = socket.gethostbyname(socket.gethostname())
+                except OSError:
+                    advertise = "127.0.0.1"
+                predictor_host = f"{advertise}:{port}"
         self.store.update_inference_job(inference_job_id,
-                                        status=InferenceJobStatus.RUNNING.value)
+                                        status=InferenceJobStatus.RUNNING.value,
+                                        predictor_host=predictor_host)
         with self._lock:
             self._inference_jobs[inference_job_id] = handle
         return handle.predictor
@@ -222,6 +264,7 @@ class ServicesManager:
             th.join(timeout=timeout)
         if handle.http_server is not None:
             handle.http_server.shutdown()
+            handle.http_server.server_close()  # release the listening FD now
         self.store.update_inference_job(inference_job_id,
                                         status=InferenceJobStatus.STOPPED.value)
 

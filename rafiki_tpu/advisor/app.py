@@ -1,0 +1,116 @@
+"""Advisor over HTTP: service app + client handle.
+
+Reference parity: rafiki/advisor/app.py (unverified — SURVEY.md §2):
+a small Flask app exposing propose / feedback so train workers in
+other processes (the reference: other containers) share one
+optimisation state. Here: a werkzeug WSGI app the ProcessScheduler
+runs on a loopback port, guarded by a shared secret header (the
+reference used its service network for isolation; loopback + secret is
+the host-local equivalent).
+"""
+
+from __future__ import annotations
+
+import json
+import hmac
+from typing import Optional
+
+from werkzeug.routing import Map, Rule
+from werkzeug.wrappers import Request, Response
+
+from rafiki_tpu.advisor.service import AdvisorService
+
+_SECRET_HEADER = "X-Rafiki-Advisor-Secret"
+
+
+class AdvisorApp:
+    def __init__(self, service: AdvisorService, secret: Optional[str] = None):
+        self.service = service
+        self.secret = secret
+        self.url_map = Map([
+            Rule("/healthz", endpoint="healthz", methods=["GET"]),
+            Rule("/advisors/<advisor_id>/propose", endpoint="propose",
+                 methods=["GET"]),
+            Rule("/advisors/<advisor_id>/feedback", endpoint="feedback",
+                 methods=["POST"]),
+        ])
+
+    def __call__(self, environ, start_response):
+        request = Request(environ)
+        try:
+            adapter = self.url_map.bind_to_environ(environ)
+            endpoint, args = adapter.match()
+            if endpoint != "healthz" and self.secret is not None:
+                given = request.headers.get(_SECRET_HEADER, "")
+                if not hmac.compare_digest(given, self.secret):
+                    raise PermissionError("Bad advisor secret")
+            response = getattr(self, f"ep_{endpoint}")(request, **args)
+        except PermissionError as e:
+            response = self._json({"error": str(e)}, 401)
+        except KeyError as e:
+            response = self._json({"error": str(e)}, 404)
+        except Exception as e:
+            response = self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+        return response(environ, start_response)
+
+    @staticmethod
+    def _json(data, status: int = 200) -> Response:
+        return Response(json.dumps(data), status=status,
+                        mimetype="application/json")
+
+    def ep_healthz(self, request: Request) -> Response:
+        return self._json({"status": "ok"})
+
+    def ep_propose(self, request: Request, advisor_id: str) -> Response:
+        return self._json({"knobs": self.service.propose(advisor_id)})
+
+    def ep_feedback(self, request: Request, advisor_id: str) -> Response:
+        body = request.get_json(force=True)
+        self.service.feedback(advisor_id, float(body["score"]), body["knobs"])
+        return self._json({"ok": True})
+
+
+class HttpAdvisorHandle:
+    """Worker-side AdvisorHandle speaking to an AdvisorApp.
+
+    propose() blocks through transient connection errors (the advisor
+    server may come up a beat after the worker process) with bounded
+    retries.
+    """
+
+    def __init__(self, base_url: str, advisor_id: str,
+                 secret: Optional[str] = None, retries: int = 10,
+                 retry_delay_s: float = 0.3):
+        import requests
+
+        self._requests = requests
+        self._base = base_url.rstrip("/")
+        self._id = advisor_id
+        self._headers = {_SECRET_HEADER: secret} if secret else {}
+        self._retries = retries
+        self._retry_delay_s = retry_delay_s
+
+    def _call(self, method: str, path: str, **kwargs):
+        import time
+
+        last = None
+        for _ in range(self._retries):
+            try:
+                resp = self._requests.request(
+                    method, self._base + path, headers=self._headers,
+                    timeout=30.0, **kwargs)
+                if resp.status_code >= 400:
+                    raise RuntimeError(f"advisor HTTP {resp.status_code}: "
+                                       f"{resp.text[:200]}")
+                return resp.json()
+            except (self._requests.ConnectionError, self._requests.Timeout) as e:
+                last = e
+                time.sleep(self._retry_delay_s)
+        raise RuntimeError(f"advisor unreachable at {self._base}: {last}")
+
+    def propose(self):
+        return self._call("GET", f"/advisors/{self._id}/propose")["knobs"]
+
+    def feedback(self, score: float, knobs) -> None:
+        self._call("POST", f"/advisors/{self._id}/feedback",
+                   json={"score": float(score), "knobs": knobs})

@@ -178,3 +178,18 @@ class Client:
         out = self._post(f"/predict/{app}",
                          {"queries": queries, "app_version": app_version})
         return out["predictions"]
+
+    def predict_via_predictor(self, predictor_host: str,
+                              queries: List[Any]) -> List[Any]:
+        """POST straight to an inference job's published predictor
+        endpoint (``get_inference_job()['predictor_host']``) — the
+        reference's per-job predictor port, bypassing the admin."""
+        resp = self._session.post(f"http://{predictor_host}/predict",
+                                  json={"queries": queries}, timeout=60)
+        if resp.status_code >= 400:
+            try:
+                message = resp.json().get("error", resp.text)
+            except ValueError:
+                message = resp.text
+            raise ClientError(resp.status_code, message)
+        return resp.json()["predictions"]

@@ -1,0 +1,66 @@
+"""Predictor HTTP frontend: the published ``POST /predict`` endpoint.
+
+Reference parity: rafiki/predictor/app.py (unverified — SURVEY.md
+§3.2): each inference job publishes one predictor port; external
+clients POST queries there and get ensembled predictions. The
+services manager starts one of these per inference job (loopback by
+default; bind 0.0.0.0 for external traffic) and records host:port in
+the inference-job row so clients can discover it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from werkzeug.wrappers import Request, Response
+
+from rafiki_tpu.predictor.predictor import Predictor
+from rafiki_tpu.utils.jsonable import jsonable as _jsonable
+
+
+class PredictorApp:
+    """WSGI app: POST /predict {"queries": [...]}, GET /healthz."""
+
+    def __init__(self, predictor: Predictor):
+        self.predictor = predictor
+
+    def __call__(self, environ, start_response):
+        request = Request(environ)
+        try:
+            if request.path == "/healthz" and request.method == "GET":
+                response = self._json({"status": "ok"})
+            elif request.path == "/predict" and request.method == "POST":
+                body = request.get_json(force=True, silent=True) or {}
+                queries = body.get("queries")
+                if not isinstance(queries, list):
+                    response = self._json(
+                        {"error": "Body must be {\"queries\": [...]}"}, 400)
+                else:
+                    preds = self.predictor.predict(queries)
+                    response = self._json({"predictions": _jsonable(preds)})
+            else:
+                response = self._json({"error": "Not found"}, 404)
+        except RuntimeError as e:  # e.g. no live workers
+            response = self._json({"error": str(e)}, 503)
+        except Exception as e:
+            response = self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+        return response(environ, start_response)
+
+    @staticmethod
+    def _json(data: Any, status: int = 200) -> Response:
+        return Response(json.dumps(data), status=status,
+                        mimetype="application/json")
+
+
+def start_predictor_server(predictor: Predictor, host: str = "127.0.0.1",
+                           port: int = 0):
+    """Serve a predictor in a daemon thread; returns (server, "host:port")."""
+    import threading
+
+    from werkzeug.serving import make_server
+
+    server = make_server(host, port, PredictorApp(predictor), threaded=True)
+    threading.Thread(target=server.serve_forever, name="predictor-http",
+                     daemon=True).start()
+    return server, f"{host}:{server.server_port}"

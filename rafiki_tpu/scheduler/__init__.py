@@ -8,11 +8,13 @@ TPU-native replacements:
     device set via ``jax.default_device`` / a dp mesh. Zero setup, used
     by tests and single-host runs; workers share one XLA runtime.
   * ProcessScheduler — one subprocess per worker with
-    ``JAX_VISIBLE_DEVICES=<chip>``: fully isolated XLA runtimes and
-    compilation caches, the robust production shape (SURVEY.md §7
-    "per-chip trial isolation").
+    ``TPU_VISIBLE_CHIPS=<chip>`` (CPU fake: per-process fake chips):
+    fully isolated XLA runtimes and compilation caches, the robust
+    production shape (SURVEY.md §7 "per-chip trial isolation").
 """
 
 from rafiki_tpu.scheduler.local import LocalScheduler, TrainJobResult
+from rafiki_tpu.scheduler.process import ProcessScheduler, worker_device_env
 
-__all__ = ["LocalScheduler", "TrainJobResult"]
+__all__ = ["LocalScheduler", "ProcessScheduler", "TrainJobResult",
+           "worker_device_env"]

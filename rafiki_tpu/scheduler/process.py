@@ -1,0 +1,240 @@
+"""Process-per-chip scheduler: one OS process per worker, one (or k)
+chips per process.
+
+This is the production scheduler shape (SURVEY.md §7 "hard parts":
+per-chip trial isolation). JAX wants one runtime per process —
+concurrent trials in one process contend on compilation locks and
+device memory. Spawning each worker as a subprocess whose environment
+exposes only its own chip(s) gives the same isolation the reference
+got from one-GPU-per-container (CUDA_VISIBLE_DEVICES), with none of
+the container overhead:
+
+  * TPU: ``TPU_VISIBLE_CHIPS=<i>`` (+ per-process bounds) pins a
+    process to chip i; ``XLA_PYTHON_CLIENT_PREALLOCATE=false`` keeps
+    N runtimes from fighting over HBM at startup.
+  * CPU (tests / fake pod): each subprocess gets its own
+    ``--xla_force_host_platform_device_count=k`` fake chips.
+
+Coordination is exactly the reference's: the meta store (shared
+sqlite, atomic trial claiming) is the source of truth and the advisor
+is shared over loopback HTTP (reference: advisor container + REST).
+"""
+
+from __future__ import annotations
+
+import os
+import secrets as _secrets
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from rafiki_tpu.advisor import AdvisorService
+from rafiki_tpu.advisor.app import AdvisorApp
+from rafiki_tpu.constants import ServiceStatus, ServiceType, TrainJobStatus, TrialStatus
+from rafiki_tpu.model.base import load_model_class
+from rafiki_tpu.scheduler.local import TrainJobResult
+from rafiki_tpu.store import MetaStore, ParamsStore
+
+
+def worker_device_env(platform: str, worker_index: int,
+                      devices_per_trial: int = 1) -> Dict[str, str]:
+    """Env vars that pin a worker subprocess to its own device set."""
+    if platform == "tpu":
+        first = worker_index * devices_per_trial
+        chips = ",".join(str(first + j) for j in range(devices_per_trial))
+        return {
+            "TPU_VISIBLE_CHIPS": chips,
+            "TPU_CHIPS_PER_PROCESS_BOUNDS": f"1,{devices_per_trial},1",
+            "TPU_PROCESS_BOUNDS": "1,1,1",
+            "ALLOW_MULTIPLE_LIBTPU_LOAD": "1",
+        }
+    # cpu: every subprocess fakes its own `devices_per_trial` chips
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices_per_trial}",
+    }
+
+
+class ProcessScheduler:
+    """Same run_train_job contract as LocalScheduler, subprocess workers."""
+
+    def __init__(self, store: MetaStore, params_store: ParamsStore,
+                 db_path: Optional[str] = None,
+                 params_dir: Optional[str] = None,
+                 advisor_service: Optional[AdvisorService] = None):
+        self.store = store
+        self.params_store = params_store
+        # Subprocesses need filesystem paths, not live objects.
+        self.db_path = str(db_path if db_path is not None else store.path)
+        self.params_dir = str(params_dir if params_dir is not None
+                              else params_store.directory)
+        self.advisors = advisor_service or AdvisorService()
+
+    # -- advisor server ------------------------------------------------------
+
+    def _start_advisor_server(self):
+        from werkzeug.serving import make_server
+
+        secret = _secrets.token_hex(16)
+        app = AdvisorApp(self.advisors, secret=secret)
+        server = make_server("127.0.0.1", 0, app, threaded=True)
+        thread = threading.Thread(target=server.serve_forever,
+                                  name="advisor-http", daemon=True)
+        thread.start()
+        return server, thread, secret, f"http://127.0.0.1:{server.server_port}"
+
+    # -- the job -------------------------------------------------------------
+
+    def run_train_job(
+        self,
+        job_id: str,
+        n_workers: int = 1,
+        devices_per_trial: int = 1,
+        advisor_kind: str = "gp",
+        platform: Optional[str] = None,
+        stop_event: Optional[threading.Event] = None,
+        poll_s: float = 0.5,
+    ) -> TrainJobResult:
+        t0 = time.time()
+        job = self.store.get_train_job(job_id)
+        if job is None:
+            raise KeyError(f"No train job {job_id!r}")
+        self.store.update_train_job_status(job_id, TrainJobStatus.RUNNING.value)
+        stop_event = stop_event or threading.Event()
+        if platform is None:
+            import jax
+
+            platform = jax.default_backend()
+
+        budget = dict(job["budget"])
+        chip_budget = budget.get("CHIP_COUNT") or budget.get("GPU_COUNT")
+        if chip_budget:
+            n_workers = min(n_workers, max(1, int(chip_budget) // devices_per_trial))
+
+        server, thread, secret, advisor_url = self._start_advisor_server()
+        errors: List[str] = []
+        try:
+            subs = self.store.get_sub_train_jobs(job_id)
+            if not subs:
+                raise ValueError(f"Train job {job_id} has no sub jobs")
+            for sub in subs:
+                if stop_event.is_set():
+                    self.store.update_sub_train_job(
+                        sub["id"], status=TrainJobStatus.STOPPED.value)
+                    continue
+                self._run_sub_job(sub, job, n_workers, devices_per_trial,
+                                  advisor_kind, platform, advisor_url, secret,
+                                  stop_event, poll_s, errors)
+        finally:
+            server.shutdown()
+            thread.join(timeout=5)
+
+        subs_after = self.store.get_sub_train_jobs(job_id)
+        if stop_event.is_set():
+            status = TrainJobStatus.STOPPED.value
+        elif subs_after and all(s["status"] == TrainJobStatus.ERRORED.value
+                                for s in subs_after):
+            status = TrainJobStatus.ERRORED.value
+        else:
+            status = TrainJobStatus.COMPLETED.value
+        self.store.update_train_job_status(job_id, status)
+        return TrainJobResult(
+            job_id=job_id, status=status,
+            trials=self.store.get_trials_of_train_job(job_id),
+            best_trials=self.store.get_best_trials_of_train_job(job_id, limit=2),
+            duration_s=time.time() - t0, errors=errors)
+
+    def _run_sub_job(self, sub: dict, job: dict, n_workers: int,
+                     devices_per_trial: int, advisor_kind: str, platform: str,
+                     advisor_url: str, secret: str,
+                     stop_event: threading.Event, poll_s: float,
+                     errors: List[str]) -> None:
+        model_row = self.store.get_model(sub["model_id"])
+        try:  # validate the template before spending processes on it
+            model_cls = load_model_class(model_row["model_file"],
+                                         model_row["model_class"])
+        except Exception as e:
+            self.store.update_sub_train_job(sub["id"],
+                                            status=TrainJobStatus.ERRORED.value)
+            errors.append(f"model {model_row['name']}: {e}")
+            return
+        advisor_id = self.advisors.create_advisor(
+            model_cls.get_knob_config(),
+            kind=advisor_kind, advisor_id=sub.get("advisor_id") or None)
+        self.store.update_sub_train_job(sub["id"], advisor_id=advisor_id,
+                                        status=TrainJobStatus.RUNNING.value)
+
+        import tempfile
+
+        procs: List[subprocess.Popen] = []
+        services: List[dict] = []
+        out_files = []
+        for i in range(n_workers):
+            service = self.store.create_service(
+                ServiceType.TRAIN_WORKER.value, job_id=job["id"],
+                worker_index=i, devices=[f"{platform}:{i}"])
+            env = dict(os.environ)
+            env.update(worker_device_env(platform, i, devices_per_trial))
+            env.update({
+                "RAFIKI_WORKER_DB": self.db_path,
+                "RAFIKI_WORKER_PARAMS_DIR": self.params_dir,
+                "RAFIKI_WORKER_SUB_JOB_ID": sub["id"],
+                "RAFIKI_WORKER_ID": f"{job['id'][:8]}-p{i}",
+                "RAFIKI_WORKER_SERVICE_ID": service["id"],
+                "RAFIKI_WORKER_ADVISOR_URL": advisor_url,
+                "RAFIKI_WORKER_ADVISOR_ID": advisor_id,
+                "RAFIKI_WORKER_ADVISOR_SECRET": secret,
+            })
+            # Worker output goes to a temp file, not a pipe: a full pipe
+            # buffer would block the worker's writes and deadlock the
+            # supervise loop below.
+            out_f = tempfile.TemporaryFile(mode="w+t")
+            out_files.append(out_f)
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "rafiki_tpu.worker.main"],
+                env=env, stdout=out_f, stderr=subprocess.STDOUT, text=True)
+            procs.append(proc)
+            services.append(service)
+            self.store.update_service(service["id"],
+                                      status=ServiceStatus.RUNNING.value)
+
+        # Supervise: wait for exits; on stop_event, terminate.
+        while any(p.poll() is None for p in procs):
+            if stop_event.is_set():
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs:
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                break
+            time.sleep(poll_s)
+
+        for p, svc, out_f in zip(procs, services, out_files):
+            rc = p.wait()
+            out_f.seek(0)
+            out = out_f.read()
+            out_f.close()
+            if rc != 0 and not stop_event.is_set():
+                errors.append(f"worker {svc['worker_index']} rc={rc}: {out[-2000:]}")
+                self.store.update_service(svc["id"],
+                                          status=ServiceStatus.ERRORED.value)
+            else:
+                self.store.update_service(svc["id"],
+                                          status=ServiceStatus.STOPPED.value)
+
+        trials = self.store.get_trials_of_sub_train_job(sub["id"])
+        if stop_event.is_set():
+            sub_status = TrainJobStatus.STOPPED.value
+        elif trials and all(t["status"] == TrialStatus.ERRORED.value for t in trials):
+            sub_status = TrainJobStatus.ERRORED.value
+        elif not trials and errors:
+            sub_status = TrainJobStatus.ERRORED.value
+        else:
+            sub_status = TrainJobStatus.COMPLETED.value
+        self.store.update_sub_train_job(sub["id"], status=sub_status)
+        self.advisors.delete_advisor(advisor_id)

@@ -99,6 +99,11 @@ class MetaStore:
         with self._conn() as c:
             c.executescript(_SCHEMA)
 
+    @property
+    def path(self) -> str:
+        """Filesystem path of the sqlite file (subprocess workers reopen it)."""
+        return self._path
+
     def _conn(self) -> sqlite3.Connection:
         conn = getattr(self._local, "conn", None)
         if conn is None:

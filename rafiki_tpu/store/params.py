@@ -26,6 +26,11 @@ class ParamsStore:
         self._dir = Path(params_dir)
         self._dir.mkdir(parents=True, exist_ok=True)
 
+    @property
+    def directory(self) -> Path:
+        """Root directory (subprocess workers reopen it by path)."""
+        return self._dir
+
     def _path(self, params_id: str) -> Path:
         if "/" in params_id or ".." in params_id:
             raise ValueError(f"Bad params id {params_id!r}")

@@ -1,0 +1,65 @@
+"""Train-worker process entrypoint: ``python -m rafiki_tpu.worker.main``.
+
+Reference parity: rafiki/worker/ entrypoints (unverified — SURVEY.md
+§1 L5): the reference launches workers inside containers "driven by
+env vars (service id, job id)". Same contract here — the
+ProcessScheduler spawns this module with:
+
+  RAFIKI_WORKER_DB            meta-store sqlite path
+  RAFIKI_WORKER_PARAMS_DIR    params-store directory
+  RAFIKI_WORKER_SUB_JOB_ID    sub-train-job to pull trials for
+  RAFIKI_WORKER_ID            human-readable worker id
+  RAFIKI_WORKER_SERVICE_ID    service row to heartbeat (optional)
+  RAFIKI_WORKER_ADVISOR_URL   http://127.0.0.1:<port>
+  RAFIKI_WORKER_ADVISOR_ID    advisor to ask for knobs
+  RAFIKI_WORKER_ADVISOR_SECRET shared secret (optional)
+
+Device pinning is inherited from the environment the scheduler set
+(JAX_PLATFORMS / XLA_FLAGS / TPU_VISIBLE_CHIPS…): this process sees
+only its own chips, giving each trial an isolated XLA runtime — the
+TPU-native answer to the reference's one-GPU-per-container isolation.
+
+Exit codes: 0 = budget exhausted cleanly, 1 = crash.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    db_path = os.environ["RAFIKI_WORKER_DB"]
+    params_dir = os.environ["RAFIKI_WORKER_PARAMS_DIR"]
+    sub_job_id = os.environ["RAFIKI_WORKER_SUB_JOB_ID"]
+    worker_id = os.environ.get("RAFIKI_WORKER_ID", f"pw-{os.getpid()}")
+    service_id = os.environ.get("RAFIKI_WORKER_SERVICE_ID")
+    advisor_url = os.environ["RAFIKI_WORKER_ADVISOR_URL"]
+    advisor_id = os.environ["RAFIKI_WORKER_ADVISOR_ID"]
+    secret = os.environ.get("RAFIKI_WORKER_ADVISOR_SECRET")
+
+    # Honour a CPU-platform request before jax initialises (the image's
+    # sitecustomize force-registers a TPU backend otherwise).
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from rafiki_tpu.advisor.app import HttpAdvisorHandle
+    from rafiki_tpu.store import MetaStore, ParamsStore
+    from rafiki_tpu.worker.train import build_worker_from_store
+
+    store = MetaStore(db_path)
+    params_store = ParamsStore(params_dir)
+    advisor = HttpAdvisorHandle(advisor_url, advisor_id, secret=secret)
+    worker = build_worker_from_store(
+        store, params_store, sub_job_id, advisor,
+        worker_id=worker_id, devices=jax.devices())
+    worker.service_id = service_id
+    n = worker.run()
+    print(f"worker {worker_id}: ran {n} trials", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

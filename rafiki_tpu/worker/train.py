@@ -173,7 +173,7 @@ def build_worker_from_store(store: MetaStore, params_store: ParamsStore,
     """Reconstruct a TrainWorker from meta-store rows (the entrypoint a
     subprocess worker uses, mirroring the reference's env-var-driven
     container entrypoint)."""
-    sub_row = store._one("SELECT * FROM sub_train_jobs WHERE id=?", (sub_train_job_id,))
+    sub_row = store.get_sub_train_job(sub_train_job_id)
     if sub_row is None:
         raise KeyError(f"No sub train job {sub_train_job_id!r}")
     job = store.get_train_job(sub_row["train_job_id"])

@@ -94,10 +94,15 @@ def test_rest_full_journey(server, superadmin, tmp_path):
     assert isinstance(appdev.get_trial_logs(best[0]["id"]), list)
     assert len(appdev.get_trial_parameters(best[0]["id"])) > 100
 
-    appdev.create_inference_job("restapp")
+    inf = appdev.create_inference_job("restapp")
     queries = np.random.default_rng(0).uniform(0, 1, size=(2, 8, 8, 1)).tolist()
     preds = appdev.predict("restapp", queries)
     assert len(preds) == 2 and abs(sum(preds[0]) - 1.0) < 1e-3
+
+    # the published predictor endpoint (reference: per-job predictor port)
+    assert inf["predictor_host"]
+    direct = appdev.predict_via_predictor(inf["predictor_host"], queries)
+    assert np.allclose(direct, preds, atol=1e-6)
 
     appdev.stop_inference_job("restapp")
     with pytest.raises(ClientError) as e:
@@ -155,6 +160,15 @@ def test_stop_scoped_to_owner(server, superadmin):
     out = a1.stop_train_job("scopedapp")
     assert out["status"] in ("STOPPED", "COMPLETED", "RUNNING", "STARTED")
     a1.wait_until_train_job_has_stopped("scopedapp", timeout=120, poll_s=0.5)
+
+
+def test_web_ui_served(server):
+    import requests
+
+    resp = requests.get(f"http://127.0.0.1:{server}/")
+    assert resp.status_code == 200
+    assert "text/html" in resp.headers["Content-Type"]
+    assert "rafiki-tpu" in resp.text and "login-form" in resp.text
 
 
 def test_404s(server, superadmin):
