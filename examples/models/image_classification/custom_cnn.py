@@ -1,0 +1,82 @@
+"""Example model template: a small CNN a model developer would upload.
+
+Reference parity: examples/models/image_classification/*.py
+(unverified — SURVEY.md §2 "Example model zoo"): a standalone .py
+implementing the model contract, with an ``if __name__ == "__main__"``
+block running the developer harness — the reference's de-facto unit
+test (SURVEY.md §4).
+
+Upload with:
+    client.create_model("custom_cnn", "IMAGE_CLASSIFICATION",
+                        "examples/models/image_classification/custom_cnn.py",
+                        "CustomCnn")
+"""
+
+try:
+    import rafiki_tpu  # noqa: F401 — already importable when uploaded
+except ModuleNotFoundError:  # run as a script from a checkout
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[3]))
+
+from flax import linen as nn
+
+from rafiki_tpu.model.base import JaxModel
+from rafiki_tpu.model.knobs import (
+    CategoricalKnob,
+    FixedKnob,
+    FloatKnob,
+    IntegerKnob,
+)
+
+
+class _Cnn(nn.Module):
+    """Conv stack sized by knobs; NHWC, bf16-friendly."""
+
+    base_filters: int
+    conv_blocks: int
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for i in range(self.conv_blocks):
+            x = nn.Conv(self.base_filters * (2 ** i), (3, 3), padding="SAME")(x)
+            x = nn.relu(x)
+            x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class CustomCnn(JaxModel):
+    @staticmethod
+    def get_knob_config():
+        return {
+            "base_filters": CategoricalKnob([16, 32], affects_shape=True),
+            "conv_blocks": IntegerKnob(1, 3, affects_shape=True),
+            "learning_rate": FloatKnob(1e-4, 1e-1, is_exp=True),
+            "batch_size": CategoricalKnob([64, 128]),
+            "epochs": FixedKnob(2),
+        }
+
+    def build_module(self, num_classes, input_shape):
+        return _Cnn(base_filters=int(self.knobs["base_filters"]),
+                    conv_blocks=int(self.knobs["conv_blocks"]),
+                    num_classes=num_classes)
+
+
+if __name__ == "__main__":
+    import numpy as np
+
+    from rafiki_tpu.model.dev import test_model_class
+
+    rng = np.random.default_rng(0)
+    score, preds = test_model_class(
+        CustomCnn, "IMAGE_CLASSIFICATION",
+        "synthetic://images?classes=10&n=1024&w=16&h=16&c=3&seed=0",
+        "synthetic://images?classes=10&n=256&w=16&h=16&c=3&seed=1",
+        queries=rng.uniform(0, 1, size=(4, 16, 16, 3)).tolist(),
+    )
+    assert len(preds) == 4 and len(preds[0]) == 10

@@ -56,6 +56,9 @@ class Admin:
         # Serializes inference-job creation per process: the duplicate
         # check below is check-then-act and the REST server is threaded.
         self._inference_lock = threading.Lock()
+        from rafiki_tpu.utils.events import events
+
+        events.configure(self.config.logs_dir)
         self._seed_superadmin()
 
     def _seed_superadmin(self) -> None:

@@ -37,6 +37,7 @@ from rafiki_tpu.model.base import load_model_class
 from rafiki_tpu.predictor.predictor import Predictor
 from rafiki_tpu.scheduler.local import LocalScheduler
 from rafiki_tpu.store import MetaStore, ParamsStore
+from rafiki_tpu.utils.events import events
 from rafiki_tpu.worker.inference import InferenceWorker
 
 
@@ -160,7 +161,8 @@ class ServicesManager:
             # threads (each pinning a trained model) leak unreachably.
             handle.stop_event.set()
             for th in handle.worker_threads:
-                th.join(timeout=5)
+                if th.ident is not None:  # join only threads that started
+                    th.join(timeout=5)
             if handle.http_server is not None:
                 handle.http_server.shutdown()
                 handle.http_server.server_close()
@@ -216,6 +218,8 @@ class ServicesManager:
         self.store.update_inference_job(inference_job_id,
                                         status=InferenceJobStatus.RUNNING.value,
                                         predictor_host=predictor_host)
+        events.emit("inference_job_started", job_id=inference_job_id,
+                    n_workers=len(best_trials), predictor_host=predictor_host)
         with self._lock:
             self._inference_jobs[inference_job_id] = handle
         return handle.predictor
@@ -267,6 +271,7 @@ class ServicesManager:
             handle.http_server.server_close()  # release the listening FD now
         self.store.update_inference_job(inference_job_id,
                                         status=InferenceJobStatus.STOPPED.value)
+        events.emit("inference_job_stopped", job_id=inference_job_id)
 
     # -- teardown ------------------------------------------------------------
 

@@ -36,6 +36,8 @@ class AdvisorApp:
         ])
 
     def __call__(self, environ, start_response):
+        from werkzeug.exceptions import HTTPException
+
         request = Request(environ)
         try:
             adapter = self.url_map.bind_to_environ(environ)
@@ -45,6 +47,8 @@ class AdvisorApp:
                 if not hmac.compare_digest(given, self.secret):
                     raise PermissionError("Bad advisor secret")
             response = getattr(self, f"ep_{endpoint}")(request, **args)
+        except HTTPException as e:  # unknown route / wrong method → 404/405
+            response = self._json({"error": e.description}, e.code or 500)
         except PermissionError as e:
             response = self._json({"error": str(e)}, 401)
         except KeyError as e:

@@ -20,6 +20,7 @@ from rafiki_tpu.constants import ServiceStatus, ServiceType, TrainJobStatus, Tri
 from rafiki_tpu.model.base import load_model_class
 from rafiki_tpu.parallel.mesh import local_devices, partition_devices
 from rafiki_tpu.store import MetaStore, ParamsStore
+from rafiki_tpu.utils.events import events
 from rafiki_tpu.worker.train import InProcAdvisorHandle, TrainWorker
 
 
@@ -63,6 +64,8 @@ class LocalScheduler:
         if job is None:
             raise KeyError(f"No train job {job_id!r}")
         self.store.update_train_job_status(job_id, TrainJobStatus.RUNNING.value)
+        events.emit("train_job_started", job_id=job_id, app=job["app"],
+                    budget=job["budget"], scheduler="local")
         stop_event = stop_event or threading.Event()
 
         devices = devices if devices is not None else local_devices()
@@ -140,6 +143,8 @@ class LocalScheduler:
         else:
             status = TrainJobStatus.COMPLETED.value
         self.store.update_train_job_status(job_id, status)
+        events.emit("train_job_finished", job_id=job_id, status=status,
+                    duration_s=round(time.time() - t0, 3))
         return TrainJobResult(
             job_id=job_id,
             status=status,

@@ -36,6 +36,7 @@ from rafiki_tpu.constants import ServiceStatus, ServiceType, TrainJobStatus, Tri
 from rafiki_tpu.model.base import load_model_class
 from rafiki_tpu.scheduler.local import TrainJobResult
 from rafiki_tpu.store import MetaStore, ParamsStore
+from rafiki_tpu.utils.events import events
 
 
 def worker_device_env(platform: str, worker_index: int,
@@ -102,6 +103,8 @@ class ProcessScheduler:
         if job is None:
             raise KeyError(f"No train job {job_id!r}")
         self.store.update_train_job_status(job_id, TrainJobStatus.RUNNING.value)
+        events.emit("train_job_started", job_id=job_id, app=job["app"],
+                    budget=job["budget"], scheduler="process")
         stop_event = stop_event or threading.Event()
         if platform is None:
             import jax
@@ -127,6 +130,15 @@ class ProcessScheduler:
                 self._run_sub_job(sub, job, n_workers, devices_per_trial,
                                   advisor_kind, platform, advisor_url, secret,
                                   stop_event, poll_s, errors)
+        except BaseException:
+            # Never leave the job stuck in RUNNING: mark terminal, then
+            # re-raise for the caller.
+            self.store.update_train_job_status(job_id,
+                                               TrainJobStatus.ERRORED.value)
+            events.emit("train_job_finished", job_id=job_id,
+                        status=TrainJobStatus.ERRORED.value,
+                        duration_s=round(time.time() - t0, 3))
+            raise
         finally:
             server.shutdown()
             thread.join(timeout=5)
@@ -140,6 +152,8 @@ class ProcessScheduler:
         else:
             status = TrainJobStatus.COMPLETED.value
         self.store.update_train_job_status(job_id, status)
+        events.emit("train_job_finished", job_id=job_id, status=status,
+                    duration_s=round(time.time() - t0, 3))
         return TrainJobResult(
             job_id=job_id, status=status,
             trials=self.store.get_trials_of_train_job(job_id),
@@ -151,6 +165,7 @@ class ProcessScheduler:
                      advisor_url: str, secret: str,
                      stop_event: threading.Event, poll_s: float,
                      errors: List[str]) -> None:
+        sub_errors: List[str] = []  # this sub job's failures only
         model_row = self.store.get_model(sub["model_id"])
         try:  # validate the template before spending processes on it
             model_cls = load_model_class(model_row["model_file"],
@@ -187,6 +202,8 @@ class ProcessScheduler:
                 "RAFIKI_WORKER_ADVISOR_ID": advisor_id,
                 "RAFIKI_WORKER_ADVISOR_SECRET": secret,
             })
+            if events.path is not None:  # subprocess shares the event sink
+                env["RAFIKI_EVENTS_DIR"] = str(events.path.parent)
             # Worker output goes to a temp file, not a pipe: a full pipe
             # buffer would block the worker's writes and deadlock the
             # supervise loop below.
@@ -220,19 +237,21 @@ class ProcessScheduler:
             out = out_f.read()
             out_f.close()
             if rc != 0 and not stop_event.is_set():
-                errors.append(f"worker {svc['worker_index']} rc={rc}: {out[-2000:]}")
+                sub_errors.append(
+                    f"worker {svc['worker_index']} rc={rc}: {out[-2000:]}")
                 self.store.update_service(svc["id"],
                                           status=ServiceStatus.ERRORED.value)
             else:
                 self.store.update_service(svc["id"],
                                           status=ServiceStatus.STOPPED.value)
+        errors.extend(sub_errors)
 
         trials = self.store.get_trials_of_sub_train_job(sub["id"])
         if stop_event.is_set():
             sub_status = TrainJobStatus.STOPPED.value
         elif trials and all(t["status"] == TrialStatus.ERRORED.value for t in trials):
             sub_status = TrainJobStatus.ERRORED.value
-        elif not trials and errors:
+        elif not trials and sub_errors:  # only this sub job's failures count
             sub_status = TrainJobStatus.ERRORED.value
         else:
             sub_status = TrainJobStatus.COMPLETED.value

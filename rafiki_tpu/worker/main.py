@@ -45,6 +45,10 @@ def main() -> int:
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
+    from rafiki_tpu.utils.events import configure_from_env
+
+    configure_from_env()
+
     from rafiki_tpu.advisor.app import HttpAdvisorHandle
     from rafiki_tpu.store import MetaStore, ParamsStore
     from rafiki_tpu.worker.train import build_worker_from_store

@@ -30,6 +30,7 @@ from rafiki_tpu.model.base import BaseModel, load_model_class
 from rafiki_tpu.model.knobs import Knobs, knob_config_signature
 from rafiki_tpu.model.log import logger
 from rafiki_tpu.store import MetaStore, ParamsStore
+from rafiki_tpu.utils.events import events
 
 
 class AdvisorHandle(Protocol):
@@ -111,9 +112,12 @@ class TrainWorker:
         def sink(entry):
             self.store.add_trial_log(tid, entry)
 
+        events.emit("trial_started", trial_id=tid, sub_job_id=self.sub_id,
+                    model=self.model_class.__name__, worker_id=self.worker_id,
+                    knobs=knobs)
         model: Optional[BaseModel] = None
         try:
-            with logger.capture(sink), self._device_scope():
+            with logger.capture(sink), self._device_scope(), self._profile_scope(tid):
                 model = self.model_class(**knobs)
                 if self.devices is not None and len(self.devices) > 1 and hasattr(model, "set_mesh"):
                     from rafiki_tpu.parallel.mesh import data_parallel_mesh
@@ -124,11 +128,15 @@ class TrainWorker:
                 blob = model.dump_parameters()
             params_id = self.params_store.save(blob)
             self.store.mark_trial_as_completed(tid, score, params_id)
+            events.emit("trial_completed", trial_id=tid, score=score,
+                        worker_id=self.worker_id)
             self.advisor.feedback(score, knobs)
             return self.store.get_trial(tid)
         except Exception:
             err = traceback.format_exc()
             self.store.mark_trial_as_errored(tid, err)
+            events.emit("trial_errored", trial_id=tid, worker_id=self.worker_id,
+                        error=err.splitlines()[-1] if err else "")
             # Feed the advisor a floor score so it learns to avoid the
             # region instead of re-proposing it (reference just skips).
             try:
@@ -148,6 +156,21 @@ class TrainWorker:
 
             return jax.default_device(self.devices[0])
         return contextlib.nullcontext()
+
+    @staticmethod
+    def _profile_scope(trial_id: str):
+        """Per-trial XLA profiler trace when RAFIKI_PROFILE_DIR is set
+        (SURVEY.md §5: "jax.profiler trace per trial"). Traces land in
+        <dir>/<trial_id>/ viewable in TensorBoard / Perfetto."""
+        import contextlib
+        import os
+
+        profile_dir = os.environ.get("RAFIKI_PROFILE_DIR")
+        if not profile_dir:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.profiler.trace(os.path.join(profile_dir, trial_id))
 
     # -- the loop ------------------------------------------------------------
 

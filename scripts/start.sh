@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Start the rafiki-tpu admin server on this TPU host.
+#
+# Reference parity: scripts/start.sh (unverified — SURVEY.md §3.3)
+# boots Postgres, Redis, admin and web containers on a Docker swarm.
+# The TPU-native control plane is one process (sqlite meta store,
+# in-proc bus, web UI served by the admin app), so "start" is just
+# supervising that process.
+#
+# Configuration via env (see rafiki_tpu/config.py for the full list):
+#   RAFIKI_TPU_DATA_DIR      state root        (default ~/.rafiki_tpu)
+#   RAFIKI_TPU_ADMIN_HOST    bind address      (default 127.0.0.1)
+#   RAFIKI_TPU_ADMIN_PORT    admin port        (default 3000)
+#   RAFIKI_TPU_JWT_SECRET    token secret      (CHANGE IN PRODUCTION)
+#   RAFIKI_PROFILE_DIR       per-trial profiler traces (optional)
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+RUN_DIR="${RAFIKI_TPU_DATA_DIR:-$HOME/.rafiki_tpu}"
+mkdir -p "$RUN_DIR"
+PID_FILE="$RUN_DIR/admin.pid"
+
+if [[ -f "$PID_FILE" ]] && kill -0 "$(cat "$PID_FILE")" 2>/dev/null; then
+  echo "admin already running (pid $(cat "$PID_FILE"))"
+  exit 0
+fi
+
+nohup python -m rafiki_tpu serve > "$RUN_DIR/admin.out" 2>&1 &
+echo $! > "$PID_FILE"
+echo "rafiki-tpu admin starting (pid $(cat "$PID_FILE")); log: $RUN_DIR/admin.out"
+for _ in $(seq 1 50); do
+  if curl -fs "http://${RAFIKI_TPU_ADMIN_HOST:-127.0.0.1}:${RAFIKI_TPU_ADMIN_PORT:-3000}/healthz" > /dev/null 2>&1; then
+    echo "admin is up"
+    exit 0
+  fi
+  sleep 0.2
+done
+echo "WARNING: admin did not report healthy within 10s; check $RUN_DIR/admin.out" >&2
+exit 1

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Stop the rafiki-tpu admin server started by scripts/start.sh.
+# Reference parity: scripts/stop.sh (unverified — SURVEY.md §2).
+set -euo pipefail
+
+RUN_DIR="${RAFIKI_TPU_DATA_DIR:-$HOME/.rafiki_tpu}"
+PID_FILE="$RUN_DIR/admin.pid"
+
+if [[ ! -f "$PID_FILE" ]]; then
+  echo "no pid file at $PID_FILE — nothing to stop"
+  exit 0
+fi
+PID="$(cat "$PID_FILE")"
+if kill -0 "$PID" 2>/dev/null; then
+  kill "$PID"
+  for _ in $(seq 1 50); do
+    kill -0 "$PID" 2>/dev/null || break
+    sleep 0.2
+  done
+  kill -0 "$PID" 2>/dev/null && kill -9 "$PID" || true
+  echo "stopped admin (pid $PID)"
+else
+  echo "admin (pid $PID) was not running"
+fi
+rm -f "$PID_FILE"

@@ -1,0 +1,65 @@
+"""JSONL event stream: emission, filtering, torn-line tolerance."""
+
+import json
+
+from rafiki_tpu.utils.events import EventLog
+
+
+def test_emit_and_read(tmp_path):
+    log = EventLog(tmp_path)
+    log.emit("trial_started", trial_id="t1", knobs={"lr": 0.1})
+    log.emit("trial_completed", trial_id="t1", score=0.9)
+    log.emit("trial_started", trial_id="t2")
+    events = list(log.read())
+    assert [e["event"] for e in events] == [
+        "trial_started", "trial_completed", "trial_started"]
+    assert all("time" in e and "pid" in e for e in events)
+    completed = list(log.read("trial_completed"))
+    assert len(completed) == 1 and completed[0]["score"] == 0.9
+
+
+def test_unconfigured_is_noop(tmp_path):
+    log = EventLog()
+    log.emit("whatever", x=1)  # must not raise
+    assert list(log.read()) == []
+
+
+def test_torn_lines_skipped(tmp_path):
+    log = EventLog(tmp_path)
+    log.emit("good", n=1)
+    with open(log.path, "a") as f:
+        f.write('{"event": "torn", "n')  # crashed writer mid-line
+    log2 = EventLog()
+    log2._path = log.path
+    assert [e["event"] for e in log2.read()] == ["good"]
+
+
+def test_scheduler_emits_lifecycle(tmp_path):
+    """The local scheduler + worker emit job and trial events."""
+    from rafiki_tpu.scheduler import LocalScheduler
+    from rafiki_tpu.store import MetaStore, ParamsStore
+    from rafiki_tpu.utils.events import events
+
+    from tests.test_scheduler import FF_SOURCE, TRAIN, VAL
+
+    events.configure(tmp_path)
+    try:
+        store = MetaStore(tmp_path / "meta.sqlite3")
+        params = ParamsStore(tmp_path / "params")
+        model = store.create_model("tinyff", "IMAGE_CLASSIFICATION", None,
+                                   FF_SOURCE, "TinyFF")
+        job = store.create_train_job("evapp", "IMAGE_CLASSIFICATION", None,
+                                     TRAIN, VAL, {"MODEL_TRIAL_COUNT": 2})
+        store.create_sub_train_job(job["id"], model["id"])
+        LocalScheduler(store, params).run_train_job(job["id"], n_workers=1,
+                                                    advisor_kind="random")
+        kinds = [e["event"] for e in events.read()]
+        assert kinds[0] == "train_job_started"
+        assert kinds.count("trial_started") == 2
+        assert kinds.count("trial_completed") == 2
+        assert kinds[-1] == "train_job_finished"
+        finished = list(events.read("train_job_finished"))[-1]
+        assert finished["status"] == "COMPLETED"
+    finally:
+        events.close()
+        events._path = None
