@@ -34,8 +34,10 @@ def main(argv=None) -> int:
         return 0
     if args.command == "bench":
         import runpy
+        from pathlib import Path
 
-        runpy.run_path("bench.py", run_name="__main__")
+        bench = Path(__file__).resolve().parent.parent / "bench.py"
+        runpy.run_path(str(bench), run_name="__main__")
         return 0
     if args.command == "version":
         import rafiki_tpu

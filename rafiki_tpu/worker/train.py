@@ -69,6 +69,7 @@ class TrainWorker:
         job_created_at: Optional[float] = None,
         service_id: Optional[str] = None,
         stop_event=None,
+        async_persist: bool = True,
     ):
         if not (isinstance(model_class, type) and issubclass(model_class, BaseModel)):
             raise TypeError("model_class must subclass BaseModel")
@@ -86,6 +87,7 @@ class TrainWorker:
         self.service_id = service_id
         self._stop = stop_event
         self.trials_run = 0
+        self._saver = _AsyncSaver(self) if async_persist else None
 
     # -- budget --------------------------------------------------------------
 
@@ -116,6 +118,7 @@ class TrainWorker:
                     model=self.model_class.__name__, worker_id=self.worker_id,
                     knobs=knobs)
         model: Optional[BaseModel] = None
+        persisted_async = False
         try:
             with logger.capture(sink), self._device_scope(), self._profile_scope(tid):
                 model = self.model_class(**knobs)
@@ -125,12 +128,20 @@ class TrainWorker:
                     model.set_mesh(data_parallel_mesh(self.devices))
                 model.train(self.train_uri)
                 score = float(model.evaluate(self.val_uri))
-                blob = model.dump_parameters()
-            params_id = self.params_store.save(blob)
-            self.store.mark_trial_as_completed(tid, score, params_id)
-            events.emit("trial_completed", trial_id=tid, score=score,
-                        worker_id=self.worker_id)
+            # The advisor hears the score immediately (it steers the next
+            # proposal); parameter persistence is NOT on the critical
+            # path — the saver thread dumps/writes/marks-completed while
+            # this worker trains the next trial. Serial dump can cost as
+            # much as a short trial's train+eval (device→host fetch +
+            # serialize), so overlapping it nearly doubles short-trial
+            # throughput.
             self.advisor.feedback(score, knobs)
+            if self._saver is not None:
+                self._saver.submit(tid, model, score, sink)
+                persisted_async = True  # saver owns model.destroy() now
+            else:
+                with logger.capture(sink):
+                    self._persist(tid, model, score)
             return self.store.get_trial(tid)
         except Exception:
             err = traceback.format_exc()
@@ -145,8 +156,23 @@ class TrainWorker:
                 pass
             return self.store.get_trial(tid)
         finally:
-            if model is not None:
+            if model is not None and not persisted_async:
                 model.destroy()
+
+    def _persist(self, tid: str, model: BaseModel, score: float) -> None:
+        """Dump → write → mark completed (runs on the saver thread when
+        async persistence is on)."""
+        try:
+            blob = model.dump_parameters()
+            params_id = self.params_store.save(blob)
+            self.store.mark_trial_as_completed(tid, score, params_id)
+            events.emit("trial_completed", trial_id=tid, score=score,
+                        worker_id=self.worker_id)
+        except Exception:
+            err = traceback.format_exc()
+            self.store.mark_trial_as_errored(tid, f"params persist failed:\n{err}")
+            events.emit("trial_errored", trial_id=tid, worker_id=self.worker_id,
+                        error="params persist failed")
 
     def _device_scope(self):
         import contextlib
@@ -177,16 +203,80 @@ class TrainWorker:
     def run(self) -> int:
         """Pull trials until the budget is exhausted. Returns #trials run."""
         max_trials = self.budget.get(BudgetType.MODEL_TRIAL_COUNT.value)
-        while not self.budget_exhausted():
-            if max_trials is not None and not self.store.claim_trial_slot(
-                    self.sub_id, int(max_trials)):
-                break
-            knobs = self.advisor.propose()
-            self.run_trial(knobs)
-            self.trials_run += 1
-            if self.service_id is not None:
-                self.store.update_service(self.service_id, heartbeat=True)
+        try:
+            while not self.budget_exhausted():
+                if max_trials is not None and not self.store.claim_trial_slot(
+                        self.sub_id, int(max_trials)):
+                    break
+                knobs = self.advisor.propose()
+                self.run_trial(knobs)
+                self.trials_run += 1
+                if self.service_id is not None:
+                    self.store.update_service(self.service_id, heartbeat=True)
+        finally:
+            if self._saver is not None:
+                # close() flushes first: every trial durable before we
+                # return, and the saver thread actually exits (a bare
+                # flush would leak one live thread per worker).
+                self._saver.close()
         return self.trials_run
+
+
+class _AsyncSaver:
+    """One background thread persisting trial parameters off the
+    critical path. Bounded to one pending save: at most two param sets
+    are alive at once (the one being written and the one training), so
+    memory stays flat; a slow disk degrades to serial, never unbounded.
+    """
+
+    def __init__(self, worker: "TrainWorker"):
+        import queue
+        import threading
+
+        self._worker = worker
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._thread = threading.Thread(target=self._loop,
+                                        name=f"saver-{worker.worker_id}",
+                                        daemon=True)
+        self._thread.start()
+
+    def submit(self, trial_id: str, model: BaseModel, score: float,
+               sink=None) -> None:
+        self._q.put((trial_id, model, score, sink))
+
+    def _loop(self) -> None:
+        import contextlib
+
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            trial_id, model, score, sink = item
+            try:
+                # Re-enter the trial's log capture on this thread so
+                # logger.log() calls during dump still land in TrialLog.
+                scope = (logger.capture(sink) if sink is not None
+                         else contextlib.nullcontext())
+                with scope:
+                    self._worker._persist(trial_id, model, score)
+            except Exception:
+                pass  # _persist already contains failures; never die
+            finally:
+                try:
+                    model.destroy()
+                except Exception:
+                    pass  # a throwing destroy() must not kill the saver
+                self._q.task_done()
+
+    def flush(self) -> None:
+        """Block until all submitted saves are durable."""
+        self._q.join()
+
+    def close(self) -> None:
+        self.flush()
+        self._q.put(None)
+        self._thread.join(timeout=10)
 
 
 def build_worker_from_store(store: MetaStore, params_store: ParamsStore,
