@@ -106,6 +106,8 @@ class JaxModel(BaseModel):
         self._mesh = None
         self._seed = int(self.knobs.get("seed", 0))
         self._dataset_meta: Dict[str, Any] = {}
+        self._ckpt_sink = None  # set by the worker for mid-trial checkpoints
+        self._start_epoch = 0  # >0 after restore_checkpoint
 
     # -- knob conventions ----------------------------------------------------
 
@@ -207,9 +209,14 @@ class JaxModel(BaseModel):
                 f"Dataset architecture {(num_classes, input_shape)} does not match "
                 f"the loaded model {self._arch}; use a fresh model instance")
         logger.define_plot("Training", ["loss", "acc"], x_axis="epoch")
-        for epoch in range(self.epochs):
+        for epoch in range(self._start_epoch, self.epochs):
             metrics = self._loop.run_epoch(ds, self.batch_size, epoch_seed=self._seed + epoch)
             logger.log(epoch=epoch, **metrics)
+            self._epochs_done = epoch
+            if self._ckpt_sink is not None:
+                # The sink decides whether to materialize this epoch's
+                # snapshot (dump is a device fetch — not free).
+                self._ckpt_sink(epoch, self.dump_checkpoint)
 
     def evaluate(self, dataset_uri: str) -> float:
         if self._loop is None:
@@ -264,6 +271,53 @@ class JaxModel(BaseModel):
 
     def destroy(self) -> None:
         self._loop = None
+
+    # -- mid-trial checkpointing --------------------------------------------
+
+    def set_checkpoint_sink(self, sink) -> None:
+        """Install a per-epoch checkpoint hook: ``sink(epoch, make_blob)``
+        where ``make_blob()`` returns the full-train-state snapshot.
+        The reference has no mid-trial checkpointing (SURVEY.md §5);
+        the TrainWorker wires this to the params store so long trials
+        survive worker crashes."""
+        self._ckpt_sink = sink
+
+    def dump_checkpoint(self) -> bytes:
+        """Full resumable snapshot: params AND optimizer state AND step
+        counter (``dump_parameters`` is params-only, for serving)."""
+        import jax
+        from flax import serialization
+
+        if self._loop is None:
+            raise RuntimeError("No state to checkpoint: model not trained")
+        state = jax.device_get(self._loop.state)
+        payload = {
+            "arch": self._arch,
+            "state": serialization.to_bytes(state),
+            "epoch": getattr(self, "_epochs_done", 0),
+            "planned_steps": getattr(self, "_planned_steps", None),
+            "dataset_meta": {k: v for k, v in self._dataset_meta.items()
+                             if isinstance(v, (str, int, float, bool))},
+        }
+        return pickle.dumps(payload)
+
+    def restore_checkpoint(self, blob: bytes) -> int:
+        """Restore a ``dump_checkpoint`` snapshot; returns the epoch to
+        resume from. ``train()`` then skips the already-done epochs."""
+        import jax
+        from flax import serialization
+
+        payload = pickle.loads(blob)
+        num_classes, input_shape = payload["arch"]
+        self._dataset_meta = payload.get("dataset_meta", {})
+        if payload.get("planned_steps"):
+            self._planned_steps = payload["planned_steps"]
+        self._build_loop(num_classes, tuple(input_shape))
+        template = jax.device_get(self._loop.state)
+        state = serialization.from_bytes(template, payload["state"])
+        self._loop.state = jax.device_put(state)
+        self._start_epoch = int(payload["epoch"]) + 1
+        return self._start_epoch
 
 
 # ---------------------------------------------------------------------------

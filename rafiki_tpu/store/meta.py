@@ -330,6 +330,14 @@ class MetaStore:
                 (TrialStatus.ERRORED.value, error[:4000], _now(), trial_id),
             )
 
+    def mark_trial_as_running(self, trial_id: str) -> None:
+        """Re-adopt a trial for resume: back to RUNNING, stale error and
+        stop time cleared."""
+        with self._conn() as c:
+            c.execute(
+                "UPDATE trials SET status=?, error=NULL, stopped_at=NULL WHERE id=?",
+                (TrialStatus.RUNNING.value, trial_id))
+
     def mark_trial_as_terminated(self, trial_id: str) -> None:
         with self._conn() as c:
             c.execute("UPDATE trials SET status=?, stopped_at=? WHERE id=?",

@@ -45,6 +45,17 @@ def main() -> int:
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
+    # Multi-host pods: when the scheduler provides coordinator env, join
+    # the jax.distributed cluster over DCN before touching devices —
+    # this worker then sees its host's chips while collectives span the
+    # pod (the reference's NCCL/MPI role is played by XLA here).
+    coordinator = os.environ.get("RAFIKI_COORDINATOR_ADDRESS")
+    if coordinator:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(os.environ["RAFIKI_NUM_PROCESSES"]),
+            process_id=int(os.environ["RAFIKI_PROCESS_ID"]))
+
     from rafiki_tpu.utils.events import configure_from_env
 
     configure_from_env()

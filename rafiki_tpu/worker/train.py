@@ -70,6 +70,7 @@ class TrainWorker:
         service_id: Optional[str] = None,
         stop_event=None,
         async_persist: bool = True,
+        checkpoint_every: Optional[int] = None,
     ):
         if not (isinstance(model_class, type) and issubclass(model_class, BaseModel)):
             raise TypeError("model_class must subclass BaseModel")
@@ -88,6 +89,13 @@ class TrainWorker:
         self._stop = stop_event
         self.trials_run = 0
         self._saver = _AsyncSaver(self) if async_persist else None
+        # Mid-trial checkpoint cadence (epochs); 0/None = off. Env
+        # RAFIKI_CHECKPOINT_EVERY sets the fleet default.
+        import os
+
+        if checkpoint_every is None:
+            checkpoint_every = int(os.environ.get("RAFIKI_CHECKPOINT_EVERY", "0"))
+        self.checkpoint_every = int(checkpoint_every)
 
     # -- budget --------------------------------------------------------------
 
@@ -103,12 +111,21 @@ class TrainWorker:
 
     # -- one trial -----------------------------------------------------------
 
-    def run_trial(self, knobs: Knobs) -> dict:
+    def run_trial(self, knobs: Knobs,
+                  resume_trial_id: Optional[str] = None) -> dict:
         knob_config = self.model_class.get_knob_config()
         sig = knob_config_signature(knob_config, knobs)
-        trial = self.store.create_trial(
-            self.sub_id, self.model_class.__name__, knobs,
-            worker_id=self.worker_id, shape_sig=sig)
+        resume = resume_trial_id is not None
+        if resume:
+            trial = self.store.get_trial(resume_trial_id)
+            if trial is None:
+                raise KeyError(f"No trial {resume_trial_id!r} to resume")
+            # Adopt it: live again, stale crash error cleared.
+            self.store.mark_trial_as_running(trial["id"])
+        else:
+            trial = self.store.create_trial(
+                self.sub_id, self.model_class.__name__, knobs,
+                worker_id=self.worker_id, shape_sig=sig)
         tid = trial["id"]
 
         def sink(entry):
@@ -126,6 +143,7 @@ class TrainWorker:
                     from rafiki_tpu.parallel.mesh import data_parallel_mesh
 
                     model.set_mesh(data_parallel_mesh(self.devices))
+                self._wire_checkpoints(model, tid, resume)
                 model.train(self.train_uri)
                 score = float(model.evaluate(self.val_uri))
             # The advisor hears the score immediately (it steers the next
@@ -159,6 +177,41 @@ class TrainWorker:
             if model is not None and not persisted_async:
                 model.destroy()
 
+    def _wire_checkpoints(self, model: BaseModel, tid: str, resume: bool) -> None:
+        """Attach mid-trial checkpointing (and restore on resume) when
+        the model supports it and a cadence is configured."""
+        if resume and hasattr(model, "restore_checkpoint"):
+            latest = self.params_store.latest_checkpoint(tid)
+            if latest is not None:
+                epoch, blob = latest
+                start = model.restore_checkpoint(blob)
+                events.emit("trial_resumed", trial_id=tid,
+                            from_epoch=start, worker_id=self.worker_id)
+        if self.checkpoint_every > 0 and hasattr(model, "set_checkpoint_sink"):
+            every = self.checkpoint_every
+
+            def sink(epoch: int, make_blob) -> None:
+                if (epoch + 1) % every == 0:
+                    self.params_store.save_checkpoint(tid, epoch, make_blob())
+
+            model.set_checkpoint_sink(sink)
+
+    def resume_trial(self, trial_id: str) -> dict:
+        """Re-run an interrupted trial, continuing from its newest
+        mid-trial checkpoint if one exists (fresh start otherwise). The
+        reference cannot do this — a crashed trial is lost (SURVEY.md
+        §5 'no mid-trial checkpointing')."""
+        trial = self.store.get_trial(trial_id)
+        if trial is None:
+            raise KeyError(f"No trial {trial_id!r}")
+        out = self.run_trial(trial["knobs"], resume_trial_id=trial_id)
+        if self._saver is not None:
+            # Recovery is a synchronous API: the caller wants the final
+            # status, so drain the saver before reading the row.
+            self._saver.flush()
+            out = self.store.get_trial(trial_id)
+        return out
+
     def _persist(self, tid: str, model: BaseModel, score: float) -> None:
         """Dump → write → mark completed (runs on the saver thread when
         async persistence is on)."""
@@ -166,6 +219,7 @@ class TrainWorker:
             blob = model.dump_parameters()
             params_id = self.params_store.save(blob)
             self.store.mark_trial_as_completed(tid, score, params_id)
+            self.params_store.delete_checkpoints(tid)  # superseded
             events.emit("trial_completed", trial_id=tid, score=score,
                         worker_id=self.worker_id)
         except Exception:
@@ -242,6 +296,14 @@ class _AsyncSaver:
 
     def submit(self, trial_id: str, model: BaseModel, score: float,
                sink=None) -> None:
+        import threading
+
+        if not self._thread.is_alive():
+            # close()d by a previous run(); restart for the new caller
+            # (single-producer, so no start race).
+            self._thread = threading.Thread(
+                target=self._loop, name=self._thread.name, daemon=True)
+            self._thread.start()
         self._q.put((trial_id, model, score, sink))
 
     def _loop(self) -> None:
