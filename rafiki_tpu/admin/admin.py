@@ -303,6 +303,36 @@ class Admin:
                                "in this process")
         return predictor.predict(queries)
 
+    # -- recovery ------------------------------------------------------------
+
+    def recover_trials(self, stale_after_s: Optional[float] = None,
+                       wait: bool = True) -> List[dict]:
+        """Sweep for orphaned RUNNING trials (dead/silent workers) and
+        re-run them, resuming from mid-trial checkpoints when present.
+
+        ``wait=False`` detects and claims the orphans, then re-runs
+        them in a background thread (re-training can take minutes —
+        too long for an HTTP request); the returned rows are the
+        adopted trials, freshly RUNNING."""
+        from rafiki_tpu.scheduler.recovery import recover_orphaned_trials
+
+        stale = stale_after_s if stale_after_s is not None \
+            else self.config.worker_stale_after_s
+        orphans = self.store.get_orphaned_trials(stale)
+        if not orphans:
+            return []
+        if wait:
+            return [_public_trial(t) for t in
+                    recover_orphaned_trials(self.store, self.params_store,
+                                            stale_after_s=stale,
+                                            orphans=orphans)]
+        threading.Thread(
+            target=recover_orphaned_trials,
+            args=(self.store, self.params_store),
+            kwargs={"stale_after_s": stale, "orphans": orphans},
+            name="recovery-sweep", daemon=True).start()
+        return [_public_trial(t) for t in orphans]
+
     # -- lifecycle -----------------------------------------------------------
 
     def stop(self) -> None:

@@ -99,6 +99,7 @@ class AdminApp:
             Rule("/inference_jobs/<app>/<int:app_version>/stop",
                  endpoint="stop_inference_job", methods=["POST"]),
             Rule("/predict/<app>", endpoint="predict", methods=["POST"]),
+            Rule("/recovery", endpoint="recover", methods=["POST"]),
             Rule("/advisors/<advisor_id>/propose", endpoint="advisor_propose",
                  methods=["GET"]),
             Rule("/advisors/<advisor_id>/feedback", endpoint="advisor_feedback",
@@ -303,6 +304,15 @@ class AdminApp:
         preds = self.admin.predict(app, queries,
                                    int(body.get("app_version", -1)))
         return _json({"predictions": _jsonable(preds)})
+
+    def ep_recover(self, request: Request) -> Response:
+        self._auth(request, [UserType.ADMIN.value])
+        body = self._body(request)
+        stale = body.get("stale_after_s")
+        # Default async: re-training orphans can outlive any HTTP timeout.
+        wait = bool(body.get("wait", False))
+        return _json(self.admin.recover_trials(
+            float(stale) if stale is not None else None, wait=wait))
 
     def ep_advisor_propose(self, request: Request, advisor_id: str) -> Response:
         self._auth(request)

@@ -1,0 +1,80 @@
+"""Failure detection + elastic recovery of orphaned trials.
+
+Reference parity and beyond: SURVEY.md §5 — the reference's recovery
+is Docker-restart + mark-trial-ERRORED-and-move-on; a crashed trial's
+progress is lost. Here, workers heartbeat their service row (between
+trials in the trial loop, and within trials via the epoch-log sink),
+``MetaStore.get_orphaned_trials`` detects RUNNING trials whose service
+died or went silent, and ``recover_orphaned_trials`` re-adopts them —
+resuming from the newest mid-trial checkpoint when one exists.
+
+``stale_after_s`` must exceed the longest epoch (heartbeats are
+per-epoch inside a trial).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from rafiki_tpu.constants import ServiceStatus, ServiceType
+from rafiki_tpu.store import MetaStore, ParamsStore
+from rafiki_tpu.utils.events import events
+from rafiki_tpu.worker.train import build_worker_from_store
+
+
+class _RecoveryAdvisor:
+    """Advisor handle for adopted trials: knobs are already chosen, so
+    propose() is never valid; feedback is accepted and dropped (the
+    original advisor is usually gone with its job)."""
+
+    def propose(self):
+        raise RuntimeError("Recovery workers do not propose new trials")
+
+    def feedback(self, score: float, knobs) -> None:
+        pass
+
+
+def recover_orphaned_trials(
+    store: MetaStore,
+    params_store: ParamsStore,
+    stale_after_s: float = 60.0,
+    sub_train_job_id: Optional[str] = None,
+    devices: Optional[List[Any]] = None,
+    advisor=None,
+    orphans: Optional[List[dict]] = None,
+) -> List[dict]:
+    """Find and re-run every orphaned trial; returns final trial rows.
+
+    Safe to call periodically (a sweep): adopted trials are flipped
+    back to RUNNING with a fresh worker, so a second sweep during the
+    re-run does not double-adopt unless the recovery worker itself
+    goes silent past ``stale_after_s``.
+    """
+    orphans = orphans if orphans is not None \
+        else store.get_orphaned_trials(stale_after_s, sub_train_job_id)
+    # Claim every orphan up front (rebind to a live service) so a sweep
+    # racing this one finds no orphans left to double-adopt.
+    claimed = []
+    for trial in orphans:
+        events.emit("trial_orphan_detected", trial_id=trial["id"],
+                    worker_id=trial.get("worker_id"))
+        service = store.create_service(ServiceType.TRAIN_WORKER.value)
+        worker_id = f"recovery-{trial['id'][:8]}"
+        store.mark_trial_as_running(trial["id"], service_id=service["id"],
+                                    worker_id=worker_id)
+        store.update_service(service["id"], heartbeat=True)
+        claimed.append((trial, service, worker_id))
+
+    results: List[dict] = []
+    for trial, service, worker_id in claimed:
+        worker = build_worker_from_store(
+            store, params_store, trial["sub_train_job_id"],
+            advisor or _RecoveryAdvisor(),
+            worker_id=worker_id, devices=devices,
+            async_persist=False)  # recovery is synchronous; no saver thread
+        worker.service_id = service["id"]
+        try:
+            results.append(worker.resume_trial(trial["id"]))
+        finally:
+            store.update_service(service["id"], status=ServiceStatus.STOPPED.value)
+    return results

@@ -60,6 +60,7 @@ CREATE TABLE IF NOT EXISTS trials (
     id TEXT PRIMARY KEY, sub_train_job_id TEXT NOT NULL, no INTEGER NOT NULL,
     model_name TEXT NOT NULL, knobs TEXT NOT NULL, status TEXT NOT NULL,
     score REAL, params_id TEXT, worker_id TEXT, shape_sig TEXT,
+    service_id TEXT,
     error TEXT, started_at REAL, stopped_at REAL, created_at REAL NOT NULL
 );
 CREATE TABLE IF NOT EXISTS trial_logs (
@@ -98,6 +99,14 @@ class MetaStore:
         self._local = threading.local()
         with self._conn() as c:
             c.executescript(_SCHEMA)
+            self._migrate(c)
+
+    @staticmethod
+    def _migrate(c: sqlite3.Connection) -> None:
+        """Additive migrations for databases created by older versions."""
+        cols = {r[1] for r in c.execute("PRAGMA table_info(trials)")}
+        if "service_id" not in cols:
+            c.execute("ALTER TABLE trials ADD COLUMN service_id TEXT")
 
     @property
     def path(self) -> str:
@@ -294,19 +303,21 @@ class MetaStore:
 
     def create_trial(self, sub_train_job_id: str, model_name: str,
                      knobs: Dict[str, Any], worker_id: Optional[str] = None,
-                     shape_sig: Optional[str] = None) -> dict:
+                     shape_sig: Optional[str] = None,
+                     service_id: Optional[str] = None) -> dict:
         tid = _uid()
         with self._conn() as c:
             # 'no' is assigned inside the INSERT's write transaction so
             # concurrent workers can't get duplicate trial numbers.
             c.execute(
                 "INSERT INTO trials (id, sub_train_job_id, no, model_name, knobs, status,"
-                " worker_id, shape_sig, started_at, created_at)"
+                " worker_id, shape_sig, service_id, started_at, created_at)"
                 " VALUES (?,?,"
                 "   (SELECT COUNT(*)+1 FROM trials WHERE sub_train_job_id=?),"
-                " ?,?,?,?,?,?,?)",
+                " ?,?,?,?,?,?,?,?)",
                 (tid, sub_train_job_id, sub_train_job_id, model_name, json.dumps(knobs),
-                 TrialStatus.RUNNING.value, worker_id, shape_sig, _now(), _now()),
+                 TrialStatus.RUNNING.value, worker_id, shape_sig, service_id,
+                 _now(), _now()),
             )
         return self.get_trial(tid)
 
@@ -330,13 +341,22 @@ class MetaStore:
                 (TrialStatus.ERRORED.value, error[:4000], _now(), trial_id),
             )
 
-    def mark_trial_as_running(self, trial_id: str) -> None:
+    def mark_trial_as_running(self, trial_id: str,
+                              service_id: Optional[str] = None,
+                              worker_id: Optional[str] = None) -> None:
         """Re-adopt a trial for resume: back to RUNNING, stale error and
-        stop time cleared."""
+        stop time cleared, and — when the adopter passes its identity —
+        rebound to the new service/worker so a concurrent recovery sweep
+        sees a live owner and does not double-adopt."""
         with self._conn() as c:
             c.execute(
-                "UPDATE trials SET status=?, error=NULL, stopped_at=NULL WHERE id=?",
-                (TrialStatus.RUNNING.value, trial_id))
+                "UPDATE trials SET status=?, error=NULL, stopped_at=NULL,"
+                " started_at=?,"
+                " service_id=COALESCE(?, service_id),"
+                " worker_id=COALESCE(?, worker_id)"
+                " WHERE id=?",
+                (TrialStatus.RUNNING.value, _now(), service_id, worker_id,
+                 trial_id))
 
     def mark_trial_as_terminated(self, trial_id: str) -> None:
         with self._conn() as c:
@@ -377,6 +397,30 @@ class MetaStore:
                 (sub_train_job_id, *statuses))["n"]
         return self._one("SELECT COUNT(*) AS n FROM trials WHERE sub_train_job_id=?",
                          (sub_train_job_id,))["n"]
+
+    def get_orphaned_trials(self, stale_after_s: float,
+                            sub_train_job_id: Optional[str] = None) -> List[dict]:
+        """RUNNING trials whose owning service is terminal, missing, or
+        heartbeat-stale — i.e. trials whose worker died mid-trial. The
+        failure-detection primitive (SURVEY.md §5: heartbeats in the
+        meta store; the reference loses such trials). Trials with no
+        service_id at all are NOT flagged: a worker that registered no
+        service row opted out of failure detection, and flagging those
+        would adopt healthy in-flight trials."""
+        cutoff = _now() - stale_after_s
+        q = ("SELECT t.* FROM trials t LEFT JOIN services s ON t.service_id=s.id"
+             " WHERE t.status=? AND t.service_id IS NOT NULL AND ("
+             "   s.id IS NULL"
+             "   OR s.status IN ('STOPPED','ERRORED')"
+             "   OR s.heartbeat_at < ?)")
+        args: list = [TrialStatus.RUNNING.value, cutoff]
+        if sub_train_job_id is not None:
+            q += " AND t.sub_train_job_id=?"
+            args.append(sub_train_job_id)
+        rows = self._all(q, tuple(args))
+        for t in rows:
+            t["knobs"] = json.loads(t["knobs"])
+        return rows
 
     # -- trial logs ----------------------------------------------------------
 

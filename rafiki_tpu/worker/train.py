@@ -96,6 +96,10 @@ class TrainWorker:
         if checkpoint_every is None:
             checkpoint_every = int(os.environ.get("RAFIKI_CHECKPOINT_EVERY", "0"))
         self.checkpoint_every = int(checkpoint_every)
+        from rafiki_tpu.config import get_config
+
+        self.heartbeat_min_interval_s = get_config().trial_heartbeat_s
+        self._last_heartbeat = 0.0
 
     # -- budget --------------------------------------------------------------
 
@@ -120,16 +124,29 @@ class TrainWorker:
             trial = self.store.get_trial(resume_trial_id)
             if trial is None:
                 raise KeyError(f"No trial {resume_trial_id!r} to resume")
-            # Adopt it: live again, stale crash error cleared.
-            self.store.mark_trial_as_running(trial["id"])
+            # Adopt it: live again, stale crash error cleared, rebound
+            # to this worker so recovery sweeps see a live owner.
+            self.store.mark_trial_as_running(trial["id"],
+                                             service_id=self.service_id,
+                                             worker_id=self.worker_id)
         else:
             trial = self.store.create_trial(
                 self.sub_id, self.model_class.__name__, knobs,
-                worker_id=self.worker_id, shape_sig=sig)
+                worker_id=self.worker_id, shape_sig=sig,
+                service_id=self.service_id)
         tid = trial["id"]
 
         def sink(entry):
             self.store.add_trial_log(tid, entry)
+            if self.service_id is not None:
+                # Epoch logs double as liveness: long trials heartbeat
+                # from inside, so failure detection doesn't flag them.
+                # Throttled so chatty per-batch loggers don't turn every
+                # log line into an extra sqlite write transaction.
+                now = time.monotonic()
+                if now - self._last_heartbeat >= self.heartbeat_min_interval_s:
+                    self._last_heartbeat = now
+                    self.store.update_service(self.service_id, heartbeat=True)
 
         events.emit("trial_started", trial_id=tid, sub_job_id=self.sub_id,
                     model=self.model_class.__name__, worker_id=self.worker_id,
@@ -344,7 +361,7 @@ class _AsyncSaver:
 def build_worker_from_store(store: MetaStore, params_store: ParamsStore,
                             sub_train_job_id: str, advisor: AdvisorHandle,
                             worker_id: str = "worker-0", devices=None,
-                            stop_event=None) -> TrainWorker:
+                            stop_event=None, async_persist: bool = True) -> TrainWorker:
     """Reconstruct a TrainWorker from meta-store rows (the entrypoint a
     subprocess worker uses, mirroring the reference's env-var-driven
     container entrypoint)."""
@@ -358,5 +375,5 @@ def build_worker_from_store(store: MetaStore, params_store: ParamsStore,
         store, params_store, sub_train_job_id, model_cls, advisor,
         job["train_dataset_uri"], job["val_dataset_uri"], job["budget"],
         worker_id=worker_id, devices=devices, job_created_at=job["created_at"],
-        stop_event=stop_event,
+        stop_event=stop_event, async_persist=async_persist,
     )

@@ -1,0 +1,132 @@
+"""Failure detection + orphaned-trial recovery."""
+
+import time
+
+import pytest
+
+from rafiki_tpu.constants import ServiceStatus, ServiceType
+from rafiki_tpu.scheduler.recovery import recover_orphaned_trials
+from rafiki_tpu.store import MetaStore, ParamsStore
+
+from tests.test_checkpoint_resume import FF3_SOURCE, TRAIN, VAL
+
+
+@pytest.fixture()
+def env(tmp_path):
+    store = MetaStore(tmp_path / "meta.sqlite3")
+    params = ParamsStore(tmp_path / "params")
+    row = store.create_model("ff3", "IMAGE_CLASSIFICATION", None, FF3_SOURCE, "FF3")
+    job = store.create_train_job("recapp", "IMAGE_CLASSIFICATION", None,
+                                 TRAIN, VAL, {"MODEL_TRIAL_COUNT": 2})
+    sub = store.create_sub_train_job(job["id"], row["id"])
+    return store, params, sub
+
+
+def test_orphan_detection(env):
+    store, params, sub = env
+    svc_live = store.create_service(ServiceType.TRAIN_WORKER.value)
+    svc_dead = store.create_service(ServiceType.TRAIN_WORKER.value)
+    knobs = {"learning_rate": 3e-3, "batch_size": 32, "epochs": 3}
+    t_live = store.create_trial(sub["id"], "FF3", knobs, worker_id="w0",
+                                service_id=svc_live["id"])
+    t_dead = store.create_trial(sub["id"], "FF3", knobs, worker_id="w1",
+                                service_id=svc_dead["id"])
+    store.update_service(svc_dead["id"], status=ServiceStatus.ERRORED.value)
+    store.update_service(svc_live["id"], heartbeat=True)
+
+    orphans = store.get_orphaned_trials(stale_after_s=60)
+    assert [t["id"] for t in orphans] == [t_dead["id"]]
+
+    # a live trial goes stale once its service stops heartbeating
+    orphans = store.get_orphaned_trials(stale_after_s=-1)  # everything stale
+    assert {t["id"] for t in orphans} == {t_live["id"], t_dead["id"]}
+
+
+def test_completed_trials_never_orphaned(env):
+    store, params, sub = env
+    svc = store.create_service(ServiceType.TRAIN_WORKER.value)
+    t = store.create_trial(sub["id"], "FF3", {"epochs": 3}, service_id=svc["id"])
+    store.mark_trial_as_completed(t["id"], 0.9, None)
+    store.update_service(svc["id"], status=ServiceStatus.ERRORED.value)
+    assert store.get_orphaned_trials(stale_after_s=-1) == []
+
+
+def test_admin_recover_sync_and_background(tmp_config):
+    """Admin.recover_trials: wait=True returns terminal rows; wait=False
+    claims orphans (RUNNING, new owner) and finishes in background."""
+    import time as _time
+
+    from rafiki_tpu.admin import Admin
+
+    admin = Admin(config=tmp_config)
+    try:
+        store = admin.store
+        row = store.create_model("ff3", "IMAGE_CLASSIFICATION", None,
+                                 FF3_SOURCE, "FF3")
+        job = store.create_train_job("recadm", "IMAGE_CLASSIFICATION", None,
+                                     TRAIN, VAL, {"MODEL_TRIAL_COUNT": 2})
+        sub = store.create_sub_train_job(job["id"], row["id"])
+        knobs = {"learning_rate": 3e-3, "batch_size": 32, "epochs": 3}
+
+        def orphan():
+            svc = store.create_service(ServiceType.TRAIN_WORKER.value)
+            t = store.create_trial(sub["id"], "FF3", knobs, worker_id="dead",
+                                   service_id=svc["id"])
+            store.update_service(svc["id"], status=ServiceStatus.ERRORED.value)
+            return t
+
+        t1 = orphan()
+        out = admin.recover_trials(stale_after_s=60, wait=True)
+        assert [o["id"] for o in out] == [t1["id"]]
+        assert out[0]["status"] == "COMPLETED"
+
+        t2 = orphan()
+        out = admin.recover_trials(stale_after_s=60, wait=False)
+        assert [o["id"] for o in out] == [t2["id"]]
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:
+            if store.get_trial(t2["id"])["status"] == "COMPLETED":
+                break
+            _time.sleep(0.5)
+        assert store.get_trial(t2["id"])["status"] == "COMPLETED"
+    finally:
+        admin.stop()
+
+
+def test_recover_orphaned_trial_end_to_end(env):
+    """A trial whose worker died mid-run is detected and re-run to
+    completion by the recovery sweep (from its checkpoint when present)."""
+    store, params, sub = env
+    from rafiki_tpu.model.base import load_model_class
+    from rafiki_tpu.worker.train import TrainWorker
+
+    model_row = store.get_model(sub["model_id"])
+    cls = load_model_class(model_row["model_file"], "FF3")
+
+    class Crashy(cls):  # type: ignore[misc, valid-type]
+        def evaluate(self, uri):
+            raise KeyboardInterrupt  # hard death: no ERRORED mark
+
+    Crashy.__name__ = "FF3"
+    svc = store.create_service(ServiceType.TRAIN_WORKER.value)
+    w = TrainWorker(store, params, sub["id"], Crashy, None, TRAIN, VAL,
+                    {"MODEL_TRIAL_COUNT": 2}, worker_id="dying",
+                    async_persist=False, checkpoint_every=1)
+    w.service_id = svc["id"]
+    knobs = {"learning_rate": 3e-3, "batch_size": 32, "epochs": 3}
+    with pytest.raises(KeyboardInterrupt):
+        w.run_trial(knobs)
+    store.update_service(svc["id"], status=ServiceStatus.ERRORED.value)
+
+    # the trial is RUNNING with a dead service → orphan
+    orphans = store.get_orphaned_trials(stale_after_s=60)
+    assert len(orphans) == 1
+    assert params.latest_checkpoint(orphans[0]["id"]) is not None
+
+    results = recover_orphaned_trials(store, params, stale_after_s=60)
+    assert len(results) == 1
+    assert results[0]["status"] == "COMPLETED"
+    assert results[0]["score"] is not None
+    assert results[0]["params_id"]
+    # sweep is now clean
+    assert store.get_orphaned_trials(stale_after_s=60) == []
