@@ -171,8 +171,20 @@ class ServicesManager:
     def _start_inference(self, handle: "_InferenceJobHandle",
                          inference_job_id: str, best_trials: List[dict],
                          batch_size: int, serve_http: bool) -> Predictor:
-        for i, trial in enumerate(best_trials):
-            model = self._load_trial_model(trial)
+        models = [self._load_trial_model(t) for t in best_trials]
+
+        # Same-architecture top-k → ONE worker running a stacked vmapped
+        # forward (k models, one XLA program); otherwise the
+        # reference-shaped fallback of one worker per trial.
+        from rafiki_tpu.parallel.serving import try_build_stacked
+
+        stacked = try_build_stacked(best_trials, models, batch_size=batch_size)
+        serve_models = [stacked] if stacked is not None else models
+        if stacked is not None:
+            events.emit("inference_stacked", job_id=inference_job_id,
+                        k=len(best_trials))
+
+        for i, model in enumerate(serve_models):
             worker_id = f"{inference_job_id[:8]}-iw{i}"
             service = self.store.create_service(
                 ServiceType.INFERENCE_WORKER.value, job_id=inference_job_id,
@@ -195,7 +207,7 @@ class ServicesManager:
         deadline = 5.0
         import time
         t0 = time.monotonic()
-        while (len(self.bus.get_workers(inference_job_id)) < len(best_trials)
+        while (len(self.bus.get_workers(inference_job_id)) < len(serve_models)
                and time.monotonic() - t0 < deadline):
             time.sleep(0.01)
         predictor_host = None

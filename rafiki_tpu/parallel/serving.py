@@ -1,0 +1,92 @@
+"""Stacked serving adapter: k same-architecture trials behind one
+``predict()``.
+
+This is the serving-path payoff of SURVEY.md §7 step 8: when an
+inference job's top-k trials share a compiled-shape signature, the
+services manager serves them as ONE InferenceWorker wrapping this
+adapter — a single vmapped XLA program per query batch (optionally
+chip-sharded over a "model" mesh axis) instead of k separate workers
+each doing its own device round-trip. Heterogeneous top-k falls back
+to the reference-shaped one-worker-per-trial path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from rafiki_tpu.parallel.ensemble import StackedEnsemble
+
+
+class StackedTrialModel:
+    """Implements the slice of the model contract InferenceWorker uses
+    (``predict``/``destroy``), fusing k loaded same-arch JaxModels."""
+
+    def __init__(self, models: Sequence[Any], devices: Optional[Sequence] = None,
+                 batch_size: int = 64):
+        if not models:
+            raise ValueError("Need at least one model to stack")
+        first = models[0]
+        module = first._module
+        if any(m._arch != first._arch for m in models):
+            raise ValueError("Models disagree on architecture; cannot stack")
+        self.batch_size = int(batch_size)
+        self._first = first
+
+        def apply_fn(params, batch):
+            return module.apply({"params": params}, batch["x"], train=False)
+
+        params_list = [m._loop.params for m in models]
+        self._ens = StackedEnsemble(apply_fn, params_list, devices=devices)
+        # The stacked copy is the serving copy: drop the per-model loops
+        # (all but the first, which predict() still uses for preprocess).
+        for m in models[1:]:
+            m.destroy()
+
+    def predict(self, queries: List[Any]) -> List[List[float]]:
+        x = self._first.preprocess(
+            np.asarray(queries, dtype=self._first._input_dtype()))
+        return self.predict_proba(x).tolist()
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        """Fixed-size padded chunks: one compiled program regardless of
+        query count (micro-batches vary; XLA shapes must not)."""
+        bs = self.batch_size
+        out = []
+        for start in range(0, len(x), bs):
+            chunk = x[start:start + bs]
+            valid = len(chunk)
+            if valid < bs:
+                pad = np.zeros((bs - valid,) + chunk.shape[1:], chunk.dtype)
+                chunk = np.concatenate([chunk, pad])
+            probs = self._ens.ensemble_proba({"x": chunk})
+            out.append(probs[:valid])
+        return np.concatenate(out) if out else np.zeros((0, 0))
+
+    def destroy(self) -> None:
+        self._first.destroy()
+        self._ens = None
+
+
+def try_build_stacked(trials: List[dict], models: List[Any],
+                      devices: Optional[Sequence] = None,
+                      batch_size: int = 64) -> Optional[StackedTrialModel]:
+    """Return a stacked adapter when every trial is stackable, else None.
+
+    Stackable = same model template, same compiled-shape signature, and
+    a JaxModel-style loaded instance (module + params pytree).
+    """
+    if len(models) < 2:
+        return None
+    sigs = {t.get("shape_sig") for t in trials}
+    names = {t.get("model_name") for t in trials}
+    if len(sigs) != 1 or None in sigs or len(names) != 1:
+        return None
+    if not all(hasattr(m, "_module") and getattr(m, "_loop", None) is not None
+               for m in models):
+        return None
+    try:
+        return StackedTrialModel(models, devices=devices, batch_size=batch_size)
+    except Exception:
+        return None  # any mismatch → caller falls back to per-trial workers
