@@ -52,9 +52,11 @@ def worker_device_env(platform: str, worker_index: int,
             "ALLOW_MULTIPLE_LIBTPU_LOAD": "1",
         }
     # cpu: every subprocess fakes its own `devices_per_trial` chips
+    from rafiki_tpu.utils.backend import host_device_count_flag
+
     return {
         "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices_per_trial}",
+        "XLA_FLAGS": host_device_count_flag(devices_per_trial),
     }
 
 

@@ -1,0 +1,50 @@
+"""Backend pinning helpers.
+
+This image's sitecustomize force-registers the axon TPU PJRT backend
+regardless of ``JAX_PLATFORMS`` in the environment; ``jax.devices()``
+then hangs initializing it when the tunnel is unreachable. The explicit
+``jax.config.update("jax_platforms", "cpu")`` wins over the hijack, so
+every entry point that must run on CPU (tests, multichip dryrun,
+subprocess workers asked for cpu) funnels through here instead of
+hand-rolling the same dance.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def ensure_host_device_count(n_devices: int) -> None:
+    """Ensure XLA_FLAGS requests >= n_devices virtual CPU devices.
+
+    Replaces an inherited smaller count (e.g. a scheduler-injected
+    ``=1``) rather than deferring to it. Must run before jax's CPU
+    backend initializes to take effect.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    elif int(m.group(1)) < n_devices:
+        flags = flags[: m.start(1)] + str(n_devices) + flags[m.end(1):]
+    else:
+        return
+    os.environ["XLA_FLAGS"] = flags
+
+
+def host_device_count_flag(n_devices: int) -> str:
+    """The XLA_FLAGS fragment requesting n virtual CPU devices (the
+    single source of truth for the flag's spelling)."""
+    return f"{_COUNT_FLAG}={n_devices}"
+
+
+def force_cpu_backend(n_devices: int | None = None) -> None:
+    """Pin jax to the CPU backend, optionally with >= n virtual devices."""
+    if n_devices is not None:
+        ensure_host_device_count(n_devices)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -43,7 +43,9 @@ def main() -> int:
     import jax
 
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+        from rafiki_tpu.utils.backend import force_cpu_backend
+
+        force_cpu_backend()
 
     # Multi-host pods: when the scheduler provides coordinator env, join
     # the jax.distributed cluster over DCN before touching devices —

@@ -8,16 +8,13 @@ schedulers, meshes and collectives are exercised without TPU hardware.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 # This image's sitecustomize force-registers a TPU PJRT plugin backend
 # regardless of JAX_PLATFORMS; the explicit config update wins.
-import jax  # noqa: E402
+from rafiki_tpu.utils.backend import force_cpu_backend  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_backend(n_devices=8)
 
 import pytest  # noqa: E402
 
