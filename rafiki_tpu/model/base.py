@@ -129,18 +129,34 @@ class JaxModel(BaseModel):
     def build_module(self, num_classes: int, input_shape: tuple):
         """Return a flax.linen.Module mapping x -> logits."""
 
-    def make_optimizer(self):
+    def make_base_optimizer(self):
+        """Lr-free optimizer core for the standard (program-shared)
+        path: the train step applies ``-effective_lr(hyper, step)``
+        itself, so learning rate and warmup are traced scalars and an
+        lr sweep reuses ONE compiled XLA program."""
         import optax
 
-        # Linear warmup guards deep nets (GroupNorm + bf16) against the
-        # early-step collapse that makes high-lr trials score as noise —
-        # without it the advisor's lr axis has a cliff instead of a slope.
-        # Capped at 10% of the planned steps so short trials still train.
-        planned = getattr(self, "_planned_steps", None)
-        warmup = int(self.knobs.get(
-            "warmup_steps",
-            min(100, max(1, (planned or 1000) // 10))))
-        sched = optax.linear_schedule(0.0, self.learning_rate, warmup)
+        return optax.scale_by_adam()
+
+    def _warmup_steps(self) -> int:
+        """Linear warmup guards deep nets (GroupNorm + bf16) against
+        the early-step collapse that makes high-lr trials score as
+        noise — without it the advisor's lr axis has a cliff instead of
+        a slope. Capped at 10% of the planned steps so short trials
+        still train."""
+        planned = getattr(self, "_planned_steps", None) or 1000
+        return int(self.knobs.get("warmup_steps",
+                                  min(100, max(1, planned // 10))))
+
+    def make_optimizer(self):
+        """Legacy override hook: return a *complete* optax optimizer
+        (lr baked in). Overriding this opts the template out of
+        cross-lr program sharing — same-knob trials still reuse the
+        compiled program, but each distinct lr/schedule compiles its
+        own. Prefer ``make_base_optimizer`` + the lr knob."""
+        import optax
+
+        sched = optax.linear_schedule(0.0, self.learning_rate, self._warmup_steps())
         return optax.adam(sched)
 
     def preprocess(self, x: np.ndarray) -> np.ndarray:
@@ -158,16 +174,57 @@ class JaxModel(BaseModel):
     def set_mesh(self, mesh) -> None:
         self._mesh = mesh
 
+    def _dynamic_hyper(self, takes_dropout: bool) -> Dict[str, float]:
+        """Values for the traced hyper dict carried in the train state.
+        Everything here changes per trial WITHOUT recompiling."""
+        hyper = {"lr": float(self.learning_rate),
+                 "warmup": float(self._warmup_steps())}
+        if takes_dropout and "dropout" in self.knobs:
+            hyper["dropout"] = float(self.knobs["dropout"])
+        return hyper
+
+    def _program_key(self, num_classes: int, input_shape: tuple,
+                     takes_dropout: bool, custom_opt: bool):
+        """Cache key for the compiled Program: everything that can
+        reach the traced computation EXCEPT the structurally dynamic
+        knobs (lr/warmup via update scaling, dropout via the hyper
+        dict, epochs = python loop count, seed = init rng value).
+        A custom make_optimizer may bake any knob (and the planned-step
+        count, via schedules) into its trace, so only seed is excluded
+        and the planned steps are keyed in."""
+        from rafiki_tpu.ops.train import DYNAMIC_KNOBS
+
+        if custom_opt:
+            dyn = {"seed"}
+            extra = (getattr(self, "_planned_steps", None),)
+        else:
+            dyn = set(DYNAMIC_KNOBS) if takes_dropout else set(DYNAMIC_KNOBS) - {"dropout"}
+            extra = ()
+        baked = tuple(sorted((k, repr(v)) for k, v in self.knobs.items()
+                             if k not in dyn))
+        return (type(self).__module__, type(self).__qualname__,
+                num_classes, tuple(input_shape), baked, custom_opt) + extra
+
     def _build_loop(self, num_classes: int, input_shape: tuple):
-        import jax
+        import functools
+        import inspect
+
         from rafiki_tpu.ops.train import TrainLoop
 
         module = self.build_module(num_classes, input_shape)
+        # Modules whose __call__ accepts ``dropout_rate`` get it as a
+        # traced scalar from the hyper dict (see ops.train.dropout) —
+        # a dropout sweep then reuses one compiled program.
+        takes_dropout = "dropout_rate" in inspect.signature(
+            type(module).__call__).parameters
+        custom_opt = type(self).make_optimizer is not JaxModel.make_optimizer
 
-        def apply_train(params, batch, train=False, rng=None):
+        def apply_train(params, batch, train=False, rng=None, hyper=None):
             kwargs = {}
             if rng is not None:
                 kwargs["rngs"] = {"dropout": rng}
+            if takes_dropout and hyper is not None and "dropout" in hyper:
+                kwargs["dropout_rate"] = hyper["dropout"]
             return module.apply({"params": params}, batch["x"], train=train, **kwargs)
 
         def apply_eval(params, batch):
@@ -178,12 +235,24 @@ class JaxModel(BaseModel):
             variables = module.init(rng, dummy, train=False)
             return variables["params"]
 
-        def loss_fn(params, batch, rng):
-            return self.loss(params, batch, rng, apply_train)
+        def loss_fn(params, batch, rng, hyper):
+            return self.loss(params, batch, rng,
+                             functools.partial(apply_train, hyper=hyper))
+
+        hyper = self._dynamic_hyper(takes_dropout)
+        if custom_opt:
+            optimizer = self.make_optimizer()
+            hyper.pop("lr", None)  # lr lives inside the custom optimizer
+            hyper.pop("warmup", None)
+        else:
+            optimizer = self.make_base_optimizer()
 
         self._module = module
-        self._loop = TrainLoop(init_fn, apply_eval, loss_fn, self.make_optimizer(),
-                               mesh=self._mesh, seed=self._seed)
+        self._loop = TrainLoop(
+            init_fn, apply_eval, loss_fn, optimizer,
+            mesh=self._mesh, seed=self._seed, hyper=hyper,
+            program_key=self._program_key(num_classes, input_shape,
+                                          takes_dropout, custom_opt))
         self._arch = (num_classes, tuple(input_shape))
 
     def _input_dtype(self):
@@ -314,7 +383,20 @@ class JaxModel(BaseModel):
             self._planned_steps = payload["planned_steps"]
         self._build_loop(num_classes, tuple(input_shape))
         template = jax.device_get(self._loop.state)
-        state = serialization.from_bytes(template, payload["state"])
+        try:
+            state = serialization.from_bytes(template, payload["state"])
+        except Exception:
+            # Older checkpoints (pre-hyper 4-tuple state and/or a
+            # different optimizer layout): salvage the trained params
+            # and step counter — the expensive part — and reinitialize
+            # optimizer state / rng / hyper fresh.
+            raw = serialization.msgpack_restore(payload["state"])
+            params = serialization.from_state_dict(template[0], raw["0"])
+            try:
+                step = serialization.from_state_dict(template[2], raw["2"])
+            except Exception:
+                step = template[2]
+            state = (params, template[1], step, template[3], template[4])
         self._loop.state = jax.device_put(state)
         self._start_epoch = int(payload["epoch"]) + 1
         return self._start_epoch

@@ -43,7 +43,7 @@ class _Vgg(nn.Module):
     dtype: object = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, dropout_rate=None):
         x = x.astype(self.dtype)
         for v in _CFGS[self.depth]:
             if v == "M":
@@ -57,7 +57,14 @@ class _Vgg(nn.Module):
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(max(64, int(512 * self.width_mult)), dtype=self.dtype)(x)
         x = nn.relu(x)
-        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        # dropout_rate may be a TRACED scalar (rafiki_tpu.ops.dropout),
+        # so a dropout sweep shares one compiled program; falls back to
+        # the static attribute when called without one.
+        if train:
+            from rafiki_tpu.ops.train import dropout as _dropout
+
+            rate = self.dropout if dropout_rate is None else dropout_rate
+            x = _dropout(x, rate, self.make_rng("dropout"), deterministic=False)
         return nn.Dense(self.num_classes, dtype=self.dtype)(x)
 
 

@@ -7,17 +7,29 @@ everything that touches the device goes through here or through
 """
 
 from rafiki_tpu.ops.train import (
+    DYNAMIC_KNOBS,
+    Program,
     TrainLoop,
+    clear_program_cache,
     cross_entropy_loss,
+    dropout,
+    get_program,
     make_eval_step,
     make_predict_fn,
     make_train_step,
+    program_cache_stats,
 )
 
 __all__ = [
+    "DYNAMIC_KNOBS",
+    "Program",
     "TrainLoop",
+    "clear_program_cache",
     "cross_entropy_loss",
+    "dropout",
+    "get_program",
     "make_train_step",
     "make_eval_step",
     "make_predict_fn",
+    "program_cache_stats",
 ]

@@ -5,9 +5,18 @@ each model template's ``train()`` (TF session.run / torch .backward(),
 100% of GPU time — SURVEY.md §3.1). Here the loop is first-party and
 TPU-shaped:
 
-  * one compiled XLA program per (knob-signature, batch-shape); the
-    step is ``jax.jit`` with donated carry state, so params/opt-state
-    stay resident in HBM and the host only ships input batches;
+  * one compiled XLA program per *program key* — NOT per trial. The
+    compiled steps live in a :class:`Program`, cached process-wide by
+    :func:`get_program`, so back-to-back trials whose traced
+    computation is identical reuse the same executables with zero
+    retrace/recompile (SURVEY.md §7 "compile-time vs trial throughput:
+    this is where the ≥8x trials/hour target is won or lost");
+  * high-churn continuous hyperparameters (learning rate, warmup
+    horizon, dropout rate) are *dynamic*: they ride in the train state
+    as traced f32 scalars instead of baking into the XLA program, so
+    an AutoML sweep over them hits one compiled program;
+  * the step is ``jax.jit`` with donated carry state, so params /
+    opt-state stay resident in HBM and the host only ships batches;
   * optional within-trial data parallelism: pass a ``Mesh`` and batches
     are sharded over the ``"dp"`` axis while state is replicated — XLA
     inserts the gradient all-reduce (psum over ICI) automatically from
@@ -18,9 +27,10 @@ TPU-shaped:
 
 from __future__ import annotations
 
-import functools
+import inspect
+import threading
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+from typing import Any, Callable, Dict, Hashable, Iterable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +40,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 Batch = Dict[str, np.ndarray]
 Params = Any
-LossFn = Callable[[Params, Dict[str, jnp.ndarray], jax.Array], Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]]
+# Canonical loss signature: (params, batch, rng, hyper) -> (loss, metrics).
+# 3-arg (params, batch, rng) losses are auto-wrapped for compatibility.
+LossFn = Callable[..., Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]]
+
+# Knob names that are structurally dynamic in the standard template
+# path: they reach the computation only through the traced hyper dict
+# (lr / warmup via the update scaling, dropout via apply), or never
+# reach the trace at all (epochs = python loop count, seed = init rng).
+# Model templates must not bake these into module attributes.
+DYNAMIC_KNOBS = frozenset({"learning_rate", "warmup_steps", "dropout", "epochs", "seed"})
 
 
 def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray,
@@ -53,6 +72,22 @@ def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray,
     correct = (jnp.argmax(logits, axis=-1) == labels_safe) & mask
     acc = correct.sum() / denom
     return loss, acc
+
+
+def dropout(x: jnp.ndarray, rate, rng, deterministic: bool) -> jnp.ndarray:
+    """Inverted dropout with a *traced* rate.
+
+    Unlike ``flax.linen.Dropout`` (whose rate is a static module
+    attribute → every distinct rate is a distinct XLA program), the
+    rate here may be a traced scalar, so an AutoML sweep over dropout
+    reuses one compiled program.
+    """
+    if deterministic or rng is None:
+        return x
+    rate = jnp.asarray(rate, jnp.float32)
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    scale = jnp.where(rate < 1.0, 1.0 / jnp.maximum(1.0 - rate, 1e-6), 0.0)
+    return jnp.where(keep, x * scale.astype(x.dtype), jnp.zeros_like(x))
 
 
 @dataclass
@@ -84,65 +119,191 @@ class _ShardingPlan:
         return jax.device_put(state, self.state_sharding)
 
 
-def make_train_step(loss_fn: LossFn, optimizer: optax.GradientTransformation,
-                    plan: _ShardingPlan):
-    """Build the donated, jit'd SGD step.
+def _as_hyper_loss(loss_fn: LossFn) -> LossFn:
+    """Accept both (params, batch, rng) and (params, batch, rng, hyper)."""
+    try:
+        n = len(inspect.signature(loss_fn).parameters)
+    except (TypeError, ValueError):
+        n = 4
+    if n >= 4:
+        return loss_fn
+    return lambda params, batch, rng, hyper: loss_fn(params, batch, rng)
 
-    state = (params, opt_state, step, rng). The whole carry is donated:
-    XLA reuses the HBM buffers in place, so per-step host traffic is
-    just the input batch.
+
+def effective_lr(hyper: Dict[str, jnp.ndarray], step_i) -> jnp.ndarray:
+    """Linear warmup to hyper["lr"] over hyper["warmup"] steps — all
+    traced, so warmup horizon and peak lr never force a recompile."""
+    warmup = jnp.maximum(hyper.get("warmup", jnp.float32(1.0)), 1.0)
+    frac = jnp.minimum((step_i.astype(jnp.float32) + 1.0) / warmup, 1.0)
+    return hyper["lr"] * frac
+
+
+class Program:
+    """The compiled, trial-independent half of a training loop.
+
+    Holds the jit'd init / train / eval / predict callables plus the
+    optimizer and sharding plan. A Program is safe to share across
+    trials (and across worker threads) whose traced computation is
+    identical: per-trial state (params, opt state, rng, hyper scalars)
+    lives in :class:`TrainLoop`, never here.
+
+    Two lr modes:
+      * ``dynamic_lr=True`` (standard template path): ``optimizer`` is
+        lr-free (e.g. ``optax.scale_by_adam()``); the step scales
+        updates by ``-effective_lr(hyper, step)``. Trials differing in
+        lr / warmup share this Program.
+      * ``dynamic_lr=False`` (custom ``make_optimizer`` overrides): the
+        optimizer carries its own lr; reuse requires identical knobs.
     """
 
-    def step(state, batch):
-        params, opt_state, step_i, rng = state
-        rng, sub = jax.random.split(rng)
-        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch, sub)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        metrics = dict(metrics, loss=loss)
-        return (params, opt_state, step_i + 1, rng), metrics
+    def __init__(self, init_fn, apply_fn, loss_fn: LossFn,
+                 optimizer: optax.GradientTransformation,
+                 plan: _ShardingPlan, dynamic_lr: bool = True):
+        self.plan = plan
+        self.optimizer = optimizer
+        self.dynamic_lr = dynamic_lr
+        self.apply_fn = apply_fn
+        loss4 = _as_hyper_loss(loss_fn)
 
-    kwargs = {}
-    if plan.mesh is not None:
-        # Shardings are pytree-prefixes: replicate all of state, shard all of batch.
-        kwargs = dict(
-            in_shardings=(plan.state_sharding, plan.batch_sharding),
-            out_shardings=(plan.state_sharding, plan.state_sharding),
-        )
-    return jax.jit(step, donate_argnums=(0,), **kwargs)
+        def train_step(state, batch):
+            params, opt_state, step_i, rng, hyper = state
+            rng, sub = jax.random.split(rng)
+            (loss, metrics), grads = jax.value_and_grad(loss4, has_aux=True)(
+                params, batch, sub, hyper)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            if dynamic_lr:
+                lr = effective_lr(hyper, step_i)
+                updates = jax.tree.map(lambda u: (-lr).astype(u.dtype) * u, updates)
+            params = optax.apply_updates(params, updates)
+            metrics = dict(metrics, loss=loss)
+            return (params, opt_state, step_i + 1, rng, hyper), metrics
+
+        def eval_step(params, batch):
+            logits = apply_fn(params, batch)
+            labels = batch["y"]
+            mask = labels >= 0
+            if "valid" in batch:
+                v = batch["valid"]
+                mask = jnp.logical_and(mask, v.reshape(v.shape + (1,) * (mask.ndim - v.ndim)))
+            labels_safe = jnp.where(mask, labels, 0)
+            correct = (jnp.argmax(logits, axis=-1) == labels_safe) & mask
+            return correct.sum(), mask.sum()
+
+        def predict(params, batch):
+            logits = apply_fn(params, batch)
+            return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+        def init_all(rng):
+            params = init_fn(rng)
+            return params, optimizer.init(params)
+
+        tkw: Dict[str, Any] = {}
+        ekw: Dict[str, Any] = {}
+        ikw: Dict[str, Any] = {}
+        if plan.mesh is not None:
+            tkw = dict(in_shardings=(plan.state_sharding, plan.batch_sharding),
+                       out_shardings=(plan.state_sharding, plan.state_sharding))
+            ekw = dict(in_shardings=(plan.state_sharding, plan.batch_sharding))
+            ikw = dict(out_shardings=plan.state_sharding)
+        self.train_step = jax.jit(train_step, donate_argnums=(0,), **tkw)
+        self.eval_step = jax.jit(eval_step, **ekw)
+        self.predict = jax.jit(predict, **ekw)
+        self.init = jax.jit(init_all, **ikw)
 
 
-def make_eval_step(apply_fn, plan: _ShardingPlan):
-    """Jit'd eval step returning (#correct, #valid) so the host can sum."""
+# ---------------------------------------------------------------------------
+# Process-wide program cache
+# ---------------------------------------------------------------------------
+#
+# Key insight for AutoML throughput: a worker process runs many trials
+# back to back; without reuse, every trial pays a full XLA retrace +
+# recompile (measured ~13s for VGG16 on a v5e chip vs ~1.2s of actual
+# training). The cache below makes the second same-key trial free.
+#
+# Granularity note: the per-key lock deduplicates *Program
+# construction* (the traced-closure objects); the XLA executables
+# inside compile lazily at each jitted callable's first call per
+# (shape, device) signature. That is the right granularity here:
+# LocalScheduler's concurrent worker threads run on *different*
+# devices, whose executables are necessarily distinct compiles, while
+# same-device repeat trials (the steady state) hit the jit cache.
+# Cross-process dedup is the persistent XLA compilation cache's job
+# (utils.backend.enable_compilation_cache).
+#
+# The cache is capped (LRU): a long sweep over shape-affecting knobs
+# evicts the oldest programs instead of pinning every compiled
+# executable for the process lifetime. Live TrainLoops keep their
+# Program via their own reference, so eviction is always safe.
 
-    def step(params, batch):
-        logits = apply_fn(params, batch)
-        labels = batch["y"]
-        mask = labels >= 0
-        if "valid" in batch:
-            v = batch["valid"]
-            mask = jnp.logical_and(mask, v.reshape(v.shape + (1,) * (mask.ndim - v.ndim)))
-        labels_safe = jnp.where(mask, labels, 0)
-        correct = (jnp.argmax(logits, axis=-1) == labels_safe) & mask
-        return correct.sum(), mask.sum()
+_PROGRAM_CACHE_CAP = 64
 
-    kwargs = {}
-    if plan.mesh is not None:
-        kwargs = dict(in_shardings=(plan.state_sharding, plan.batch_sharding))
-    return jax.jit(step, **kwargs)
+_programs: "Dict[Hashable, Program]" = {}  # insertion-ordered → LRU via re-insert
+_build_locks: Dict[Hashable, threading.Lock] = {}
+_stats = {"hits": 0, "misses": 0, "evictions": 0}
+_guard = threading.Lock()
 
 
-def make_predict_fn(apply_fn, plan: _ShardingPlan):
-    """Jit'd forward returning probabilities."""
+def mesh_cache_key(mesh: Optional[Mesh]) -> Hashable:
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names), tuple(str(d) for d in mesh.devices.flat))
 
-    def fwd(params, batch):
-        logits = apply_fn(params, batch)
-        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
-    kwargs = {}
-    if plan.mesh is not None:
-        kwargs = dict(in_shardings=(plan.state_sharding, plan.batch_sharding))
-    return jax.jit(fwd, **kwargs)
+def get_program(key: Hashable, builder: Callable[[], Program]) -> Program:
+    """Return the cached Program for ``key``, building it (once, even
+    under concurrent callers) if absent.
+
+    Contract: ``key`` must fully determine the builder's inputs
+    (init/apply/loss closures, optimizer, sharding plan) — on a hit the
+    caller's builder is IGNORED in favor of the cached Program. The
+    JaxModel path guarantees this by keying every knob that can reach
+    the trace; direct callers must do the same.
+    """
+    with _guard:
+        prog = _programs.get(key)
+        if prog is not None:
+            _programs[key] = _programs.pop(key)  # refresh LRU position
+            _stats["hits"] += 1
+            return prog
+        lock = _build_locks.setdefault(key, threading.Lock())
+    with lock:
+        with _guard:
+            prog = _programs.get(key)
+            if prog is not None:
+                _stats["hits"] += 1
+                return prog
+        try:
+            prog = builder()
+        finally:
+            # Drop the build lock entry even when the builder raises
+            # (e.g. a knob combo whose trace fails) — _build_locks must
+            # not outgrow the LRU-capped _programs.
+            with _guard:
+                _build_locks.pop(key, None)
+        with _guard:
+            _programs[key] = prog
+            _stats["misses"] += 1
+            while len(_programs) > _PROGRAM_CACHE_CAP:
+                _programs.pop(next(iter(_programs)))
+                _stats["evictions"] += 1
+    return prog
+
+
+def program_cache_stats() -> Dict[str, int]:
+    with _guard:
+        return dict(_stats, size=len(_programs))
+
+
+def clear_program_cache() -> None:
+    with _guard:
+        _programs.clear()
+        _build_locks.clear()
+        _stats.update(hits=0, misses=0, evictions=0)
+
+
+# ---------------------------------------------------------------------------
+# TrainLoop: per-trial state driving a (possibly shared) Program
+# ---------------------------------------------------------------------------
 
 
 class TrainLoop:
@@ -152,26 +313,52 @@ class TrainLoop:
     ----------
     init_fn: rng -> params
     apply_fn: (params, batch) -> logits
-    loss_fn: (params, batch, rng) -> (loss, metrics dict)
-    optimizer: optax transform
+    loss_fn: (params, batch, rng[, hyper]) -> (loss, metrics dict)
+    optimizer: optax transform. With ``hyper`` containing "lr" this
+        must be lr-free (default: ``optax.scale_by_adam()``); without
+        hyper it is a complete optimizer (default: adam(1e-3)).
     mesh: optional dp Mesh (within-trial data parallelism). With a mesh
         of k devices the global batch is sharded k ways; gradients are
         all-reduced over ICI by XLA (from sharding annotations).
+    hyper: optional dict of dynamic f32 scalars carried in the state
+        ("lr", "warmup", "dropout", ...). These are traced, so trials
+        differing only in them share one compiled program.
+    program_key: optional hashable. When given, the compiled Program is
+        fetched from / stored in the process-wide cache under
+        (program_key, mesh) — the compile-amortization path.
     """
 
-    def __init__(self, init_fn, apply_fn, loss_fn, optimizer,
-                 mesh: Optional[Mesh] = None, seed: int = 0):
-        self.plan = _ShardingPlan.build(mesh)
+    def __init__(self, init_fn, apply_fn, loss_fn, optimizer=None,
+                 mesh: Optional[Mesh] = None, seed: int = 0,
+                 hyper: Optional[Dict[str, float]] = None,
+                 program_key: Optional[Hashable] = None):
+        dynamic_lr = hyper is not None and "lr" in hyper
+        if optimizer is None:
+            optimizer = optax.scale_by_adam() if dynamic_lr else optax.adam(1e-3)
+
+        def build() -> Program:
+            return Program(init_fn, apply_fn, loss_fn, optimizer,
+                           _ShardingPlan.build(mesh), dynamic_lr=dynamic_lr)
+
+        if program_key is not None:
+            self.program = get_program(
+                (program_key, mesh_cache_key(mesh), dynamic_lr), build)
+        else:
+            self.program = build()
+        self.plan = self.program.plan
         self.apply_fn = apply_fn
-        self.optimizer = optimizer
-        self._train_step = make_train_step(loss_fn, optimizer, self.plan)
-        self._eval_step = make_eval_step(apply_fn, self.plan)
-        self._predict = make_predict_fn(apply_fn, self.plan)
+        self.optimizer = self.program.optimizer
+        # Back-compat aliases (bench/tests poke the private names).
+        self._train_step = self.program.train_step
+        self._eval_step = self.program.eval_step
+        self._predict = self.program.predict
+
+        hyper_dev = {k: jnp.float32(v) for k, v in (hyper or {}).items()}
         rng = jax.random.PRNGKey(seed)
         rng, init_rng = jax.random.split(rng)
-        params = init_fn(init_rng)
-        opt_state = optimizer.init(params)
-        self.state = self.plan.put_state((params, opt_state, jnp.zeros((), jnp.int32), rng))
+        params, opt_state = self.program.init(init_rng)
+        self.state = self.plan.put_state(
+            (params, opt_state, jnp.zeros((), jnp.int32), rng, hyper_dev))
 
     @property
     def params(self):
@@ -179,8 +366,12 @@ class TrainLoop:
 
     @params.setter
     def params(self, params):
-        _, opt_state, step, rng = self.state
-        self.state = (self.plan.put_state(params), opt_state, step, rng)
+        _, opt_state, step, rng, hyper = self.state
+        self.state = (self.plan.put_state(params), opt_state, step, rng, hyper)
+
+    @property
+    def hyper(self) -> Dict[str, jax.Array]:
+        return self.state[4]
 
     def run_epoch(self, dataset, batch_size: int, epoch_seed: int,
                   on_metrics: Optional[Callable[[int, Dict[str, float]], None]] = None) -> Dict[str, float]:
@@ -202,14 +393,17 @@ class TrainLoop:
         return {k: float(v) for k, v in metrics.items()} if count else {}
 
     def evaluate(self, dataset, batch_size: int) -> float:
-        total_correct = 0
-        total = 0
+        # (correct, valid) accumulate as device scalars; the adds
+        # dispatch asynchronously and the host syncs ONCE at the end
+        # (a per-batch int() sync would serialize host<->device).
+        total_correct = jnp.zeros((), jnp.int32)
+        total = jnp.zeros((), jnp.int32)
         for batch in dataset.batches(batch_size, shuffle=False, drop_remainder=False):
             dev_batch = self.plan.put_batch(batch)
             c, n = self._eval_step(self.state[0], dev_batch)
-            total_correct += int(c)
-            total += int(n)
-        return total_correct / max(total, 1)
+            total_correct = total_correct + c
+            total = total + n
+        return int(total_correct) / max(int(total), 1)
 
     def predict_proba(self, x: np.ndarray, batch_size: int, extra: Optional[Batch] = None) -> np.ndarray:
         """Forward a query array; pads to full batches, returns (N, ..., C) probs."""
@@ -226,3 +420,37 @@ class TrainLoop:
             probs = np.asarray(self._predict(self.state[0], self.plan.put_batch(batch)))
             outs.append(probs[: batch_size - pad] if pad else probs)
         return np.concatenate(outs) if outs else np.zeros((0,))
+
+
+# ---------------------------------------------------------------------------
+# Standalone builders (legacy surface; Program is the primary API)
+# ---------------------------------------------------------------------------
+
+
+def make_train_step(loss_fn: LossFn, optimizer: optax.GradientTransformation,
+                    plan: _ShardingPlan, dynamic_lr: bool = False):
+    """Build a donated, jit'd SGD step.
+
+    NOTE (contract change vs round 1): the carried state is now the
+    5-tuple (params, opt_state, step, rng, hyper) — ``hyper`` may be
+    an empty dict when no dynamic hyperparameters are used.
+    """
+    prog = Program(lambda rng: None, lambda p, b: None, loss_fn, optimizer,
+                   plan, dynamic_lr=dynamic_lr)
+    return prog.train_step
+
+
+def make_eval_step(apply_fn, plan: _ShardingPlan):
+    """Jit'd eval step returning (#correct, #valid) device scalars."""
+    prog = Program(lambda rng: None, apply_fn,
+                   lambda p, b, r, h: (jnp.float32(0.0), {}),
+                   optax.identity(), plan, dynamic_lr=False)
+    return prog.eval_step
+
+
+def make_predict_fn(apply_fn, plan: _ShardingPlan):
+    """Jit'd forward returning probabilities."""
+    prog = Program(lambda rng: None, apply_fn,
+                   lambda p, b, r, h: (jnp.float32(0.0), {}),
+                   optax.identity(), plan, dynamic_lr=False)
+    return prog.predict

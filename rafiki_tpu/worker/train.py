@@ -201,9 +201,16 @@ class TrainWorker:
             latest = self.params_store.latest_checkpoint(tid)
             if latest is not None:
                 epoch, blob = latest
-                start = model.restore_checkpoint(blob)
-                events.emit("trial_resumed", trial_id=tid,
-                            from_epoch=start, worker_id=self.worker_id)
+                try:
+                    start = model.restore_checkpoint(blob)
+                    events.emit("trial_resumed", trial_id=tid,
+                                from_epoch=start, worker_id=self.worker_id)
+                except Exception:
+                    # An unreadable checkpoint (e.g. written by an older
+                    # state format) must not error the trial — the knobs
+                    # are fine; rerun from scratch.
+                    events.emit("checkpoint_restore_failed", trial_id=tid,
+                                worker_id=self.worker_id)
         if self.checkpoint_every > 0 and hasattr(model, "set_checkpoint_sink"):
             every = self.checkpoint_every
 
