@@ -29,13 +29,18 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.command == "serve":
         from rafiki_tpu.admin.app import serve
+        from rafiki_tpu.utils.backend import enable_compilation_cache
 
+        enable_compilation_cache()
         serve(host=args.host, port=args.port)
         return 0
     if args.command == "bench":
         import runpy
         from pathlib import Path
 
+        from rafiki_tpu.utils.backend import enable_compilation_cache
+
+        enable_compilation_cache()
         bench = Path(__file__).resolve().parent.parent / "bench.py"
         runpy.run_path(str(bench), run_name="__main__")
         return 0

@@ -51,6 +51,11 @@ class Config:
 
     # Compute
     default_dtype: str = "bfloat16"
+    # Storage dtype for serving params blobs (dump_parameters). The
+    # default bfloat16 halves the device→host fetch and is math-
+    # identical for templates that compute in bf16 (params are cast
+    # down at every conv/dense anyway); set "float32" to keep masters.
+    serving_params_dtype: str = "bfloat16"
 
     @property
     def db_path(self) -> Path:

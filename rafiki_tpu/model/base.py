@@ -311,21 +311,26 @@ class JaxModel(BaseModel):
     # -- params --------------------------------------------------------------
 
     def dump_parameters(self) -> bytes:
-        import jax
-        from flax import serialization
+        from rafiki_tpu.config import get_config
+        from rafiki_tpu.utils.serial import dump_pytree
 
         if self._loop is None:
             raise RuntimeError("No parameters to dump: model not trained/loaded")
-        params = jax.device_get(self._loop.params)
+        # Packed single-transfer dump (utils/serial.py): persisting is
+        # on the steady-state throughput path via the async saver, and
+        # per-leaf device_get costs ~2x the packed fetch.
+        cast = get_config().serving_params_dtype == "bfloat16"
         payload = {
             "arch": self._arch,
-            "params": serialization.to_bytes(params),
+            "packed": dump_pytree(self._loop.params, cast_f32_to_bf16=cast),
             "dataset_meta": {k: v for k, v in self._dataset_meta.items()
                               if isinstance(v, (str, int, float, bool))},
         }
         return pickle.dumps(payload)
 
     def load_parameters(self, blob: bytes) -> None:
+        import jax
+        import jax.numpy as jnp
         from flax import serialization
 
         payload = pickle.loads(blob)
@@ -333,9 +338,17 @@ class JaxModel(BaseModel):
         self._dataset_meta = payload.get("dataset_meta", {})
         self._build_loop(num_classes, tuple(input_shape))
         template = self._loop.params
-        params = serialization.from_bytes(template, payload["params"])
-        import jax
+        if "packed" in payload:
+            from rafiki_tpu.utils.serial import load_pytree
 
+            state = load_pytree(payload["packed"])
+            params = serialization.from_state_dict(template, state)
+            # Upcast any bf16-stored leaves back to the template dtype
+            # (exact: bf16 -> f32 is an injection).
+            params = jax.tree.map(
+                lambda t, v: jnp.asarray(v, jnp.asarray(t).dtype), template, params)
+        else:  # pre-packed-format blobs (flax msgpack)
+            params = serialization.from_bytes(template, payload["params"])
         self._loop.params = jax.device_put(params)
 
     def destroy(self) -> None:
@@ -353,16 +366,15 @@ class JaxModel(BaseModel):
 
     def dump_checkpoint(self) -> bytes:
         """Full resumable snapshot: params AND optimizer state AND step
-        counter (``dump_parameters`` is params-only, for serving)."""
-        import jax
-        from flax import serialization
+        counter (``dump_parameters`` is params-only, for serving).
+        Full precision (resume must be exact), packed single-transfer."""
+        from rafiki_tpu.utils.serial import dump_pytree
 
         if self._loop is None:
             raise RuntimeError("No state to checkpoint: model not trained")
-        state = jax.device_get(self._loop.state)
         payload = {
             "arch": self._arch,
-            "state": serialization.to_bytes(state),
+            "state_packed": dump_pytree(self._loop.state, cast_f32_to_bf16=False),
             "epoch": getattr(self, "_epochs_done", 0),
             "planned_steps": getattr(self, "_planned_steps", None),
             "dataset_meta": {k: v for k, v in self._dataset_meta.items()
@@ -382,15 +394,19 @@ class JaxModel(BaseModel):
         if payload.get("planned_steps"):
             self._planned_steps = payload["planned_steps"]
         self._build_loop(num_classes, tuple(input_shape))
-        template = jax.device_get(self._loop.state)
-        try:
-            state = serialization.from_bytes(template, payload["state"])
-        except Exception:
-            # Older checkpoints (pre-hyper 4-tuple state and/or a
-            # different optimizer layout): salvage the trained params
-            # and step counter — the expensive part — and reinitialize
-            # optimizer state / rng / hyper fresh.
+        template = self._loop.state
+        if "state_packed" in payload:
+            from rafiki_tpu.utils.serial import load_pytree
+
+            raw = load_pytree(payload["state_packed"])
+        else:  # pre-packed-format blobs (flax msgpack)
             raw = serialization.msgpack_restore(payload["state"])
+        try:
+            state = serialization.from_state_dict(template, raw)
+        except Exception:
+            # Checkpoints from an older state/optimizer layout: salvage
+            # the trained params and step counter — the expensive part —
+            # and reinitialize optimizer state / rng / hyper fresh.
             params = serialization.from_state_dict(template[0], raw["0"])
             try:
                 step = serialization.from_state_dict(template[2], raw["2"])

@@ -274,15 +274,20 @@ def get_program(key: Hashable, builder: Callable[[], Program]) -> Program:
                 return prog
         try:
             prog = builder()
-        finally:
-            # Drop the build lock entry even when the builder raises
-            # (e.g. a knob combo whose trace fails) — _build_locks must
-            # not outgrow the LRU-capped _programs.
+        except BaseException:
+            # Drop the build lock entry when the builder raises (e.g. a
+            # knob combo whose trace fails) — _build_locks must not
+            # outgrow the LRU-capped _programs.
             with _guard:
                 _build_locks.pop(key, None)
+            raise
         with _guard:
+            # Publish and retire the build lock atomically: popping the
+            # lock before publishing would let a concurrent caller
+            # install a fresh lock and build a duplicate.
             _programs[key] = prog
             _stats["misses"] += 1
+            _build_locks.pop(key, None)
             while len(_programs) > _PROGRAM_CACHE_CAP:
                 _programs.pop(next(iter(_programs)))
                 _stats["evictions"] += 1

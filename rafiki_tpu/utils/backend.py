@@ -48,3 +48,35 @@ def force_cpu_backend(n_devices: int | None = None) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def enable_compilation_cache(cache_dir: str | os.PathLike | None = None,
+                             min_compile_time_s: float = 1.0) -> str:
+    """Turn on XLA's persistent (on-disk) compilation cache.
+
+    The in-process program cache (ops.train.get_program) amortizes
+    compiles across trials of ONE worker process; this cache amortizes
+    them across processes and restarts — the second process-per-chip
+    worker to hit a given (program, topology) loads the serialized
+    executable from disk instead of recompiling. Every long-lived entry
+    point (subprocess workers, bench, admin boot) calls this.
+
+    Returns the cache directory in use.
+    """
+    if cache_dir is None:
+        cache_dir = os.environ.get("RAFIKI_XLA_CACHE_DIR")
+    if cache_dir is None:
+        from rafiki_tpu.config import get_config
+
+        cache_dir = get_config().data_dir / "xla_cache"
+    min_compile_time_s = float(
+        os.environ.get("RAFIKI_XLA_CACHE_MIN_S", min_compile_time_s))
+    cache_dir = str(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_time_s))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir

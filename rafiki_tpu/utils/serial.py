@@ -1,0 +1,117 @@
+"""Fast single-transfer pytree serialization for trial parameters.
+
+Why this exists: persisting a trial's parameters is on the steady-state
+throughput path (the async saver overlaps it with the next trial's
+training, so trial wall-clock is max(compute, persist) — see
+worker/train.py). Measured on the v5e chip, fetching VGG16's params
+costs ~2.6s at full precision while the host-side serialization costs
+~0.1s: the device→host transfer is bandwidth-bound and dominates. So:
+
+  * float32 leaves are optionally cast to bfloat16 ON DEVICE by a
+    single jit'd elementwise tree-map (compiles in <1s; a device-side
+    concat into one buffer was also tried and fetches slightly faster
+    warm, but its 43-way concat took XLA:TPU ~2 minutes to compile —
+    not worth it), halving the bytes over the wire (~0.9s for VGG16);
+  * leaf transfers are started with ``copy_to_host_async`` before any
+    is consumed, so the host walk overlaps the device DMA;
+  * the host side writes raw little-endian buffers — no msgpack.
+
+The bf16 cast is the DEFAULT for serving blobs and loses nothing:
+model templates compute in bfloat16 on the MXU anyway (every
+conv/dense casts its params down per flax ``dtype=bfloat16``), so a
+bf16-stored parameter produces bit-identical serving math. Full-
+precision masters for resume live in ``dump_checkpoint``, not here.
+Opt out with cast_f32_to_bf16=False (config:
+serving_params_dtype="float32").
+
+Format (version RTPK1): magic, u64-le header length, JSON header
+listing (key, shape, dtype) per leaf in key order, then the raw
+concatenated little-endian buffers. Readable with numpy alone.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+MAGIC = b"RTPK1\n"
+
+_EXTRA_DTYPES = {
+    "bfloat16": ml_dtypes.bfloat16,
+    "float8_e4m3fn": getattr(ml_dtypes, "float8_e4m3fn", None),
+}
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name in _EXTRA_DTYPES and _EXTRA_DTYPES[name] is not None:
+        return np.dtype(_EXTRA_DTYPES[name])
+    return np.dtype(name)
+
+
+@jax.jit
+def _cast_tree_bf16(tree):
+    return jax.tree.map(
+        lambda l: l.astype(jnp.bfloat16) if l.dtype == jnp.float32 else l, tree)
+
+
+def _flat_items(tree: Any):
+    """Stable (path-string, leaf) pairs for a params pytree / state dict."""
+    from flax import serialization
+    from flax.traverse_util import flatten_dict
+
+    state = serialization.to_state_dict(tree)
+    flat = flatten_dict(state, sep="/")
+    return sorted(flat.items())
+
+
+def dump_pytree(tree: Any, cast_f32_to_bf16: bool = True) -> bytes:
+    """Serialize a pytree of arrays: raw buffers, pipelined transfers."""
+    if cast_f32_to_bf16:
+        tree = _cast_tree_bf16(tree)
+    items = _flat_items(tree)
+    spec = []
+    leaves = []
+    for k, v in items:
+        v = jnp.asarray(v)
+        leaves.append(v)
+        spec.append({"k": k, "shape": list(v.shape), "dtype": v.dtype.name})
+    header = json.dumps(spec).encode()
+    # Kick off every device->host copy before consuming any.
+    for v in leaves:
+        if hasattr(v, "copy_to_host_async"):
+            v.copy_to_host_async()
+    parts = [MAGIC, len(header).to_bytes(8, "little"), header]
+    parts.extend(np.ascontiguousarray(np.asarray(v)).tobytes() for v in leaves)
+    return b"".join(parts)
+
+
+def is_packed(blob: bytes) -> bool:
+    return blob[: len(MAGIC)] == MAGIC
+
+
+def load_pytree(blob: bytes) -> Dict[str, Any]:
+    """Inverse of :func:`dump_pytree` → nested state dict of np arrays
+    (restore into a template with ``flax.serialization.from_state_dict``)."""
+    from flax.traverse_util import unflatten_dict
+
+    if not is_packed(blob):
+        raise ValueError("not a RTPK1 packed pytree blob")
+    off = len(MAGIC)
+    hlen = int.from_bytes(blob[off : off + 8], "little")
+    off += 8
+    spec = json.loads(blob[off : off + hlen].decode())
+    off += hlen
+    flat = {}
+    for ent in spec:
+        dt = _np_dtype(ent["dtype"])
+        shape = tuple(ent["shape"])
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        arr = np.frombuffer(blob, dtype=dt, count=n, offset=off).reshape(shape)
+        flat[ent["k"]] = arr
+        off += n * dt.itemsize
+    return unflatten_dict(flat, sep="/")

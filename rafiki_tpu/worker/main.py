@@ -47,6 +47,14 @@ def main() -> int:
 
         force_cpu_backend()
 
+    # Persistent XLA compilation cache: a restarted (or sibling) worker
+    # loads executables compiled by any previous process instead of
+    # recompiling — the cross-process half of compile amortization (the
+    # in-process half is ops.train's program cache).
+    from rafiki_tpu.utils.backend import enable_compilation_cache
+
+    enable_compilation_cache()
+
     # Multi-host pods: when the scheduler provides coordinator env, join
     # the jax.distributed cluster over DCN before touching devices —
     # this worker then sees its host's chips while collectives span the

@@ -208,9 +208,12 @@ class TrainWorker:
                 except Exception:
                     # An unreadable checkpoint (e.g. written by an older
                     # state format) must not error the trial — the knobs
-                    # are fine; rerun from scratch.
+                    # are fine; rerun from scratch. Keep the cause: a
+                    # systematic format regression must be tellable
+                    # apart from one stale legacy blob.
                     events.emit("checkpoint_restore_failed", trial_id=tid,
-                                worker_id=self.worker_id)
+                                worker_id=self.worker_id,
+                                error=traceback.format_exc(limit=5))
         if self.checkpoint_every > 0 and hasattr(model, "set_checkpoint_sink"):
             every = self.checkpoint_every
 

@@ -63,6 +63,23 @@ def test_process_train_job(env):
     assert len(params.load(best["params_id"])) > 100
 
 
+def test_workers_populate_persistent_xla_cache(env, tmp_path, monkeypatch):
+    """Subprocess workers enable jax's on-disk compilation cache
+    (worker/main.py): after a job, compiled executables are on disk for
+    future processes to load instead of recompiling."""
+    cache_dir = tmp_path / "xla-cache"
+    monkeypatch.setenv("RAFIKI_XLA_CACHE_DIR", str(cache_dir))
+    monkeypatch.setenv("RAFIKI_XLA_CACHE_MIN_S", "0")  # CPU compiles are fast
+    store, params, model = env
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 1})
+    sched = ProcessScheduler(store, params)
+    result = sched.run_train_job(job["id"], n_workers=1,
+                                 advisor_kind="random", platform="cpu")
+    assert result.status == "COMPLETED", result.errors
+    entries = list(cache_dir.glob("*"))
+    assert entries, "no persistent-cache entries written by the worker"
+
+
 def test_process_job_stop_event(env):
     store, params, model = env
     job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 500})

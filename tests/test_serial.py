@@ -1,0 +1,78 @@
+"""Packed single-transfer pytree serialization (utils/serial.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from rafiki_tpu.utils.serial import dump_pytree, is_packed, load_pytree
+
+
+def test_round_trip_full_precision():
+    tree = {
+        "dense": {"kernel": np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32),
+                  "bias": np.zeros((4,), np.float32)},
+        "step": np.int32(17),
+        "scale": np.float32(2.5),
+    }
+    blob = dump_pytree(tree, cast_f32_to_bf16=False)
+    assert is_packed(blob)
+    out = load_pytree(blob)
+    np.testing.assert_array_equal(out["dense"]["kernel"], tree["dense"]["kernel"])
+    np.testing.assert_array_equal(out["dense"]["bias"], tree["dense"]["bias"])
+    assert int(out["step"]) == 17
+    assert float(out["scale"]) == 2.5
+
+
+def test_bf16_cast_halves_floats_only():
+    import ml_dtypes
+
+    tree = {"w": np.ones((16,), np.float32) * 1.5,
+            "idx": np.arange(4, dtype=np.int32)}
+    blob = dump_pytree(tree, cast_f32_to_bf16=True)
+    out = load_pytree(blob)
+    assert out["w"].dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(out["w"].astype(np.float32), tree["w"])
+    assert out["idx"].dtype == np.int32
+    np.testing.assert_array_equal(out["idx"], tree["idx"])
+
+
+def test_tuple_state_and_bf16_leaves():
+    state = (
+        {"k": jnp.ones((3, 3), jnp.bfloat16)},
+        jnp.zeros((), jnp.int32),
+    )
+    out = load_pytree(dump_pytree(state, cast_f32_to_bf16=True))
+    # flax state-dict addresses tuple slots as "0", "1"
+    assert out["0"]["k"].shape == (3, 3)
+    assert int(out["1"]) == 0
+
+
+def test_empty_tree():
+    assert load_pytree(dump_pytree({})) == {}
+
+
+def test_reject_garbage():
+    with pytest.raises(ValueError):
+        load_pytree(b"not a packed blob")
+
+
+def test_model_params_round_trip_serving_math():
+    """dump_parameters -> load_parameters must preserve predictions
+    exactly (bf16 storage is math-identical for bf16-compute modules)."""
+    from rafiki_tpu.models.ff import FeedForward
+
+    tr = "synthetic://images?classes=4&n=128&w=8&h=8&c=1&seed=0"
+    m1 = FeedForward(hidden_layers=1, hidden_units=32, learning_rate=1e-3,
+                     batch_size=32, epochs=1, seed=0)
+    m1.train(tr)
+    q = np.random.default_rng(3).uniform(0, 1, size=(8, 8, 8, 1)).astype(np.float32)
+    p1 = np.asarray(m1.predict_proba(q))
+    blob = m1.dump_parameters()
+
+    m2 = FeedForward(hidden_layers=1, hidden_units=32, learning_rate=1e-3,
+                     batch_size=32, epochs=1, seed=0)
+    m2.load_parameters(blob)
+    p2 = np.asarray(m2.predict_proba(q))
+    np.testing.assert_allclose(p1, p2, rtol=1e-2, atol=1e-3)
+    assert np.array_equal(p1.argmax(-1), p2.argmax(-1))
