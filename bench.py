@@ -1,135 +1,389 @@
 """Benchmark: CIFAR-10-class AutoML trial throughput on one chip.
 
-Prints ONE JSON line:
+Prints ONE JSON line on stdout (always — a watchdog guarantees it even
+on hangs; failures carry an "error" field with whatever was measured):
+
   {"metric": "cifar10_automl_trials_per_hour", "value": N,
-   "unit": "trials/hour/chip", "vs_baseline": R}
+   "unit": "trials/hour/chip", "vs_baseline": R, "detail": {...}}
 
-Method: measure steady-state bf16 training throughput (images/sec) and
-evaluation throughput of the canonical workload — VGG16 (width 1.0,
-batch 128) on CIFAR-shaped data (32x32x3) — on this chip, plus the
-measured fixed per-trial overhead (advisor propose/feedback + params
-dump). From those, compute the wall-clock of one canonical AutoML
-trial (1 epoch over the 50,000-image CIFAR-10 train split + eval over
-the 10,000-image test split) and report trials/hour.
+Method — MEASURED, not extrapolated: the headline number comes from
+running a real N-trial AutoML job end to end through LocalScheduler on
+this chip — GP advisor proposing knobs, trials trained/evaluated/
+persisted by the actual worker loop — and dividing trials by total
+wall-clock. That wall-clock INCLUDES every XLA compile, advisor call,
+dataset load and parameter dump the job performed (the round-2 bench
+excluded a measured 12.8s/trial compile the framework then couldn't
+amortize; the program cache + persistent compilation cache now
+amortize it for real, and the number says so honestly).
 
-vs_baseline: the 8xV100 reference baseline from BASELINE.md — the
-reference publishes no numbers (BASELINE.json "published": {}), so the
-documented estimate there is 120 trials/hour/GPU for this canonical
-trial (V100 mixed-precision VGG16 CIFAR-10 ≈ 1.8k img/s → ~28s/epoch
-+ eval + AutoML overhead ≈ 30s/trial). vs_baseline = value / 120,
-i.e. the per-chip ratio; the v5e-8 vs 8xV100 pod ratio is the same
-number. The north-star target is vs_baseline ≥ 8.
+Canonical workload (mirrors BASELINE.md acceptance configs 2-3): VGG16
+width 1.0 on CIFAR-shaped synthetic data (50k train / 10k eval,
+32x32x3, 10 classes), one epoch per trial; the GP sweeps lr, dropout
+and batch size — the compile-relevant axis (batch) exercises the
+program cache across its 3 shape buckets. The synthetic task's
+attainable top-1 is ~1.0 (class templates + sigma=0.35 noise);
+``best_top1`` below 0.95 indicates a learning regression, satisfying
+the north star's "matched final top-1" clause for the synthetic proxy.
+
+Also reported (detail): steady-state trials/hour over the warm tail,
+per-step training throughput and MFU vs the v5e's 197 TFLOP/s bf16
+peak, advisor cost measured POST-GP-fit (>=30 observations), params
+dump time, and program/compile-cache statistics.
+
+vs_baseline: the 120 trials/hour/GPU denominator is an ESTIMATE
+(BASELINE.md §Baseline derivation: V100 mixed-precision VGG16
+CIFAR-10 ~1.8k img/s => ~28s epoch + eval + AutoML overhead ~30s per
+canonical trial; the reference publishes no numbers). The per-chip
+ratio equals the v5e-8 vs 8xV100 pod ratio. North star: >= 8.
+
+Env knobs: RAFIKI_BENCH_TRIALS (default 30), RAFIKI_BENCH_DEADLINE_S
+(default 1500), RAFIKI_BENCH_PLATFORM=cpu (tiny smoke-scale run for
+tests), RAFIKI_BENCH_SELFTEST_FAIL=1 (forced failure, tests the error
+path).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
 import time
 
-import numpy as np
+BASELINE_TRIALS_PER_HOUR_PER_GPU = 120.0  # estimate — BASELINE.md §Baseline derivation
+V5E_BF16_PEAK_FLOPS = 197e12
+CANON_TRAIN, CANON_EVAL = 50_000, 10_000
 
-CANON_TRAIN = 50_000
-CANON_EVAL = 10_000
-BASELINE_TRIALS_PER_HOUR_PER_GPU = 120.0
+_OUT = {
+    "metric": "cifar10_automl_trials_per_hour",
+    "value": 0.0,
+    "unit": "trials/hour/chip",
+    "vs_baseline": 0.0,
+    "detail": {"baseline_basis": "120 trials/hour/GPU — ESTIMATE, derivation in BASELINE.md"},
+}
+_EMIT_LOCK = threading.Lock()
+_emitted = False
 
 
-def main() -> None:
+def _emit(error: str | None = None) -> None:
+    """Print the single JSON result line exactly once. The lock makes
+    the watchdog wait out an in-flight normal emit instead of racing it
+    (two lines / a truncated line would break the driver's parse)."""
+    global _emitted
+    with _EMIT_LOCK:
+        if _emitted:
+            return
+        _emitted = True
+        if error is not None:
+            _OUT["error"] = error
+        print(json.dumps(_OUT), flush=True)
+
+
+def _watchdog(deadline_s: float):
+    def fire():
+        _emit(error=f"deadline exceeded ({deadline_s:.0f}s); partial detail included")
+        # stdout is delivered; nothing graceful left to do.
+        os._exit(3)
+
+    t = threading.Timer(deadline_s, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+# -- backend ----------------------------------------------------------------
+
+
+def _probe_backend_subprocess(timeout_s: float) -> tuple[bool, str]:
+    """Check device availability in a THROWAWAY subprocess: jax backend
+    init has no timeout and hangs indefinitely when the TPU tunnel is
+    down (BENCH_r01's failure mode), and a hung thread can't be
+    cancelled — a subprocess can."""
+    code = ("import jax; d = jax.devices(); "
+            "print(d[0].platform, len(d))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, "backend probe timed out (TPU tunnel down?)"
+    if r.returncode != 0:
+        return False, f"backend probe rc={r.returncode}: {r.stderr.strip()[-400:]}"
+    return True, r.stdout.strip()
+
+
+def _init_backend() -> str:
+    """Retry-with-backoff backend init; returns the platform string."""
+    if os.environ.get("RAFIKI_BENCH_SELFTEST_FAIL"):
+        raise RuntimeError("selftest: forced backend failure")
+    if os.environ.get("RAFIKI_BENCH_PLATFORM", "").lower() == "cpu":
+        from rafiki_tpu.utils.backend import force_cpu_backend
+
+        force_cpu_backend()
+        import jax
+
+        return jax.devices()[0].platform
+    delays = [0, 10, 30]
+    last = ""
+    for d in delays:
+        if d:
+            time.sleep(d)
+        ok, msg = _probe_backend_subprocess(timeout_s=90)
+        last = msg
+        if ok:
+            import jax
+
+            return jax.devices()[0].platform
+    raise RuntimeError(f"backend unavailable after {len(delays)} attempts: {last}")
+
+
+# -- canonical bench model ---------------------------------------------------
+#
+# The canonical trial fixes the architecture (VGG16 width 1.0, 1 epoch
+# — the unit the 120/hour baseline estimate prices) and sweeps the
+# tuning axes: lr (log), dropout, batch size. Source form because the
+# scheduler loads model templates from uploaded bytes, same as users do.
+
+BENCH_MODEL_SRC = b'''
+from rafiki_tpu.model.knobs import CategoricalKnob, FixedKnob, FloatKnob
+from rafiki_tpu.models.vgg import Vgg, _Vgg
+
+
+class BenchVgg(Vgg):
+    """Canonical-trial VGG16: fixed arch, tunable lr/dropout/batch."""
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "depth": FixedKnob(16),
+            "width_mult": FixedKnob(1.0),
+            "dropout": FloatKnob(0.0, 0.5),
+            "learning_rate": FloatKnob(1e-4, 3e-2, is_exp=True),
+            "batch_size": CategoricalKnob([64, 128, 256], affects_shape=True),
+            "epochs": FixedKnob(1),
+            "seed": FixedKnob(0),
+        }
+'''
+
+BENCH_MODEL_SRC_SMOKE = b'''
+from rafiki_tpu.model.knobs import CategoricalKnob, FixedKnob, FloatKnob
+from rafiki_tpu.models.vgg import Vgg, _Vgg
+
+
+class BenchVgg(Vgg):
+    """Smoke-scale canonical trial for CPU test runs."""
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "depth": FixedKnob(11),
+            "width_mult": FixedKnob(0.25),
+            "dropout": FloatKnob(0.0, 0.5),
+            "learning_rate": FloatKnob(1e-4, 3e-2, is_exp=True),
+            "batch_size": CategoricalKnob([64, 128], affects_shape=True),
+            "epochs": FixedKnob(1),
+            "seed": FixedKnob(0),
+        }
+'''
+
+
+def _scale(platform: str) -> dict:
+    if platform == "cpu":  # smoke run for tests: seconds, not minutes
+        return dict(src=BENCH_MODEL_SRC_SMOKE, train_n=512, eval_n=128,
+                    w=8, trials=int(os.environ.get("RAFIKI_BENCH_TRIALS", "3")),
+                    micro_steps=5, canon_train=512, canon_eval=128,
+                    micro=dict(depth=11, width=0.25, batch=64))
+    return dict(src=BENCH_MODEL_SRC, train_n=CANON_TRAIN, eval_n=CANON_EVAL,
+                w=32, trials=int(os.environ.get("RAFIKI_BENCH_TRIALS", "30")),
+                micro_steps=100, canon_train=CANON_TRAIN, canon_eval=CANON_EVAL,
+                micro=dict(depth=16, width=1.0, batch=128))
+
+
+# -- the real AutoML loop (headline) ----------------------------------------
+
+
+def run_real_loop(sc: dict, detail: dict) -> None:
+    from rafiki_tpu.scheduler import LocalScheduler
+    from rafiki_tpu.store import MetaStore, ParamsStore
+    from rafiki_tpu.ops.train import program_cache_stats
+
+    train_uri = (f"synthetic://images?classes=10&n={sc['train_n']}"
+                 f"&w={sc['w']}&h={sc['w']}&c=3&seed=0")
+    val_uri = (f"synthetic://images?classes=10&n={sc['eval_n']}"
+               f"&w={sc['w']}&h={sc['w']}&c=3&seed=1")
+    import shutil
+
+    tmp = tempfile.mkdtemp(prefix="rafiki-bench-")
+    try:
+        store = MetaStore(os.path.join(tmp, "meta.sqlite3"))
+        params = ParamsStore(os.path.join(tmp, "params"))
+        model = store.create_model("bench-vgg", "IMAGE_CLASSIFICATION", None,
+                                   sc["src"], "BenchVgg")
+        job = store.create_train_job("bench", "IMAGE_CLASSIFICATION", None,
+                                     train_uri, val_uri,
+                                     {"MODEL_TRIAL_COUNT": sc["trials"]})
+        store.create_sub_train_job(job["id"], model["id"])
+
+        cache0 = program_cache_stats()
+        t0 = time.monotonic()
+        result = LocalScheduler(store, params).run_train_job(
+            job["id"], n_workers=1, advisor_kind="gp")
+        wall = time.monotonic() - t0
+        cache1 = program_cache_stats()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    done = [t for t in result.trials if t["status"] == "COMPLETED"]
+    per_trial = sorted(
+        (t["stopped_at"] - t["started_at"]) for t in done
+        if t.get("stopped_at") and t.get("started_at"))
+    # Steady state = the warm tail: trials after every shape bucket has
+    # compiled. Median of the fastest half is robust to stragglers.
+    tail = per_trial[: max(1, len(per_trial) // 2)]
+    steady_s = tail[len(tail) // 2] if tail else float("nan")
+
+    detail.update({
+        "measured_trials": len(done),
+        "errored_trials": len(result.trials) - len(done),
+        "job_wall_s": round(wall, 2),
+        "measured_trials_per_hour": round(3600.0 * len(done) / wall, 2),
+        "cold_trial_s": round(per_trial[-1], 2) if per_trial else None,
+        "steady_trial_s": round(steady_s, 3),
+        "steady_trials_per_hour": round(3600.0 / steady_s, 2) if steady_s > 0 else None,
+        "best_top1": max((t["score"] for t in done), default=None),
+        "top1_target": 0.95,
+        "programs_compiled": cache1["misses"] - cache0["misses"],
+        "program_cache_hits": cache1["hits"] - cache0["hits"],
+        "job_status": result.status,
+    })
+    if result.status != "COMPLETED":
+        raise RuntimeError(f"bench job ended {result.status}: {result.errors[:2]}")
+    _OUT["value"] = detail["measured_trials_per_hour"]
+    _OUT["vs_baseline"] = round(_OUT["value"] / BASELINE_TRIALS_PER_HOUR_PER_GPU, 3)
+
+
+# -- microbench: step throughput, MFU, advisor, dump ------------------------
+
+
+def run_micro(sc: dict, detail: dict) -> None:
     import jax
-    import optax
-    import jax.numpy as jnp
+    import numpy as np
 
-    from rafiki_tpu.models.vgg import _Vgg
-    from rafiki_tpu.ops.train import TrainLoop, cross_entropy_loss
+    from rafiki_tpu.models.vgg import Vgg
 
-    batch = 128
-    module = _Vgg(depth=16, width_mult=1.0, num_classes=10, dropout=0.1)
+    m = sc["micro"]
+    batch = m["batch"]
+    model = Vgg(depth=m["depth"], width_mult=m["width"], dropout=0.1,
+                learning_rate=1e-3, batch_size=batch, epochs=1, seed=0)
+    tiny = (f"synthetic://images?classes=10&n={max(batch * 2, 256)}"
+            f"&w={sc['w']}&h={sc['w']}&c=3&seed=0")
+    # NOTE: run_micro executes AFTER run_real_loop on purpose — the
+    # other order would pre-warm the persistent XLA cache with the
+    # canonical HLO and the "compile-inclusive" headline would never
+    # pay the real cold compile. Here the caches are fair game: micro
+    # numbers are steady-state throughputs.
+    model.train(tiny)
 
-    def apply_fn(params, b, train=False, rng=None):
-        kwargs = {"rngs": {"dropout": rng}} if rng is not None else {}
-        return module.apply({"params": params}, b["x"], train=train, **kwargs)
-
-    def init_fn(rng):
-        return module.init(rng, jnp.zeros((1, 32, 32, 3)), train=False)["params"]
-
-    def loss_fn(params, b, rng):
-        logits = apply_fn(params, b, train=True, rng=rng)
-        loss, acc = cross_entropy_loss(logits, b["y"])
-        return loss, {"acc": acc}
-
-    loop = TrainLoop(init_fn, apply_fn, loss_fn, optax.adam(1e-3), seed=0)
-
+    loop = model._loop
     rng = np.random.default_rng(0)
-    b = {
-        "x": rng.uniform(0, 1, size=(batch, 32, 32, 3)).astype(np.float32),
-        "y": rng.integers(0, 10, size=(batch,)).astype(np.int32),
-    }
+    b = {"x": rng.uniform(0, 1, size=(batch, sc["w"], sc["w"], 3)).astype(np.float32),
+         "y": rng.integers(0, 10, size=(batch,)).astype(np.int32)}
     dev_b = loop.plan.put_batch(b)
-
-    # -- train throughput (compile, warm up, then time) ---------------------
-    # NOTE: hard-sync with device_get, not block_until_ready — on the
-    # axon-tunnelled TPU the latter returns before execution finishes,
-    # inflating throughput ~10x.
-    t_compile0 = time.monotonic()
-    loop.state, m = loop._train_step(loop.state, dev_b)
-    float(jax.device_get(m["loss"]))
-    compile_s = time.monotonic() - t_compile0
-    for _ in range(5):
-        loop.state, m = loop._train_step(loop.state, dev_b)
-    float(jax.device_get(m["loss"]))
-    steps = 100
+    # hard-sync with device_get, not block_until_ready — on the
+    # axon-tunnelled TPU the latter returns before execution finishes.
+    loop.state, mt = loop._train_step(loop.state, dev_b)
+    float(jax.device_get(mt["loss"]))
+    steps = sc["micro_steps"]
     t0 = time.monotonic()
     for _ in range(steps):
-        loop.state, m = loop._train_step(loop.state, dev_b)
-    float(jax.device_get(m["loss"]))
-    train_img_s = steps * batch / (time.monotonic() - t0)
+        loop.state, mt = loop._train_step(loop.state, dev_b)
+    float(jax.device_get(mt["loss"]))
+    step_s = (time.monotonic() - t0) / steps
+    train_img_s = batch / step_s
 
-    # -- eval throughput -----------------------------------------------------
     c, n = loop._eval_step(loop.state[0], dev_b)
     int(jax.device_get(c))
     t0 = time.monotonic()
-    for _ in range(30):
+    for _ in range(max(10, steps // 3)):
         c, n = loop._eval_step(loop.state[0], dev_b)
     int(jax.device_get(c))
-    eval_img_s = 30 * batch / (time.monotonic() - t0)
+    eval_img_s = max(10, steps // 3) * batch / (time.monotonic() - t0)
 
-    # -- fixed per-trial overhead: advisor round + params dump --------------
-    from rafiki_tpu.advisor import make_advisor
-    from rafiki_tpu.models.vgg import Vgg
-    from flax import serialization
+    # MFU from XLA's own cost model when available, else n/a.
+    mfu = None
+    try:
+        compiled = loop._train_step.lower(loop.state, dev_b).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        flops = float(ca.get("flops", 0.0))
+        if flops > 0:
+            mfu = flops / step_s / V5E_BF16_PEAK_FLOPS
+    except Exception:
+        pass
 
-    adv = make_advisor(Vgg.get_knob_config(), kind="gp", seed=0)
     t0 = time.monotonic()
-    for _ in range(3):
-        knobs = adv.propose()
-        adv.feedback(0.5, knobs)
-    advisor_s = (time.monotonic() - t0) / 3
-    t0 = time.monotonic()
-    blob = serialization.to_bytes(jax.device_get(loop.params))
+    blob = model.dump_parameters()
     dump_s = time.monotonic() - t0
 
-    # The worker persists parameters on a background saver thread
-    # (rafiki_tpu/worker/train.py _AsyncSaver), so in steady state a
-    # trial's wall clock is max(compute, persist) — the dump overlaps
-    # the NEXT trial's train+eval, not its own.
-    compute_s = (CANON_TRAIN / train_img_s) + (CANON_EVAL / eval_img_s) + advisor_s
-    trial_s = max(compute_s, dump_s)
-    trials_per_hour = 3600.0 / trial_s
-    out = {
-        "metric": "cifar10_automl_trials_per_hour",
-        "value": round(trials_per_hour, 2),
-        "unit": "trials/hour/chip",
-        "vs_baseline": round(trials_per_hour / BASELINE_TRIALS_PER_HOUR_PER_GPU, 3),
-        "detail": {
-            "train_img_per_s": round(train_img_s, 1),
-            "eval_img_per_s": round(eval_img_s, 1),
-            "canonical_trial_s": round(trial_s, 2),
-            "compile_s": round(compile_s, 1),
-            "advisor_s_per_trial": round(advisor_s, 3),
-            "params_dump_s": round(dump_s, 3),
-            "device": str(jax.devices()[0]),
-        },
-    }
-    print(json.dumps(out))
+    detail.update({
+        "train_img_per_s": round(train_img_s, 1),
+        "eval_img_per_s": round(eval_img_s, 1),
+        "params_dump_s": round(dump_s, 3),
+        "params_blob_mb": round(len(blob) / 1e6, 1),
+        "mfu_vs_v5e_bf16_peak": round(mfu, 4) if mfu is not None else None,
+        "canonical_compute_s": round(
+            sc["canon_train"] / train_img_s + sc["canon_eval"] / eval_img_s, 2),
+    })
+    model.destroy()
+
+    # Advisor cost in steady state: measured AFTER the GP has real fits
+    # (>=30 observations) — the random warmup phase costs ~0 and would
+    # understate it.
+    from rafiki_tpu.advisor import make_advisor
+    from rafiki_tpu.model.base import load_model_class
+
+    cls = load_model_class(sc["src"], "BenchVgg")
+    adv = make_advisor(cls.get_knob_config(), kind="gp", seed=0)
+    obs_rng = np.random.default_rng(1)
+    for _ in range(32):
+        knobs = adv.propose()
+        adv.feedback(float(obs_rng.uniform(0.3, 0.9)), knobs)
+    t0 = time.monotonic()
+    rounds = 5
+    for _ in range(rounds):
+        knobs = adv.propose()
+        adv.feedback(0.5, knobs)
+    detail["advisor_s_per_trial_at_30obs"] = round((time.monotonic() - t0) / rounds, 4)
+
+
+def main() -> None:
+    deadline = float(os.environ.get("RAFIKI_BENCH_DEADLINE_S", "1500"))
+    wd = _watchdog(deadline)
+    detail = _OUT["detail"]
+    try:
+        platform = _init_backend()
+        from rafiki_tpu.utils.backend import enable_compilation_cache
+
+        detail["xla_cache_dir"] = enable_compilation_cache()
+        import jax
+
+        detail["device"] = str(jax.devices()[0])
+        # Test hook: deterministic stall for the watchdog test (the
+        # real run's duration depends on cache warmth).
+        stall = float(os.environ.get("RAFIKI_BENCH_SELFTEST_SLEEP_S", "0"))
+        if stall:
+            time.sleep(stall)
+        sc = _scale(platform)
+        detail["n_trials_requested"] = sc["trials"]
+        run_real_loop(sc, detail)  # first: its compiles must be COLD
+        run_micro(sc, detail)
+        _emit()
+    except BaseException as e:  # noqa: BLE001 — the JSON line must go out
+        _emit(error=f"{type(e).__name__}: {e}")
+        wd.cancel()
+        sys.exit(1)
+    wd.cancel()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,65 @@
+"""bench.py contract: ONE parseable JSON line on stdout, always.
+
+The driver parses bench.py's stdout; BENCH_r01 failed with
+`parsed: null` when the TPU tunnel hung the backend init. These tests
+pin the hardened contract: success, forced failure, and watchdog
+deadline all still emit the JSON line (with an "error" field and
+partial detail on the failure paths).
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+BENCH = str(Path(__file__).resolve().parent.parent / "bench.py")
+
+
+def _run(env_extra: dict, timeout: int = 600):
+    env = dict(os.environ)
+    env.update(env_extra)
+    r = subprocess.run([sys.executable, BENCH], capture_output=True,
+                       text=True, timeout=timeout, env=env)
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, f"expected exactly one JSON line, got: {r.stdout!r}"
+    return r.returncode, json.loads(lines[0])
+
+
+def test_bench_smoke_cpu():
+    rc, out = _run({"RAFIKI_BENCH_PLATFORM": "cpu", "RAFIKI_BENCH_TRIALS": "3"})
+    assert rc == 0
+    assert out["metric"] == "cifar10_automl_trials_per_hour"
+    assert out["value"] > 0
+    assert out["vs_baseline"] > 0
+    assert "error" not in out
+    d = out["detail"]
+    # the headline is the measured real-loop number, compile-inclusive
+    assert d["measured_trials"] == 3
+    assert d["measured_trials_per_hour"] == out["value"]
+    assert d["job_status"] == "COMPLETED"
+    assert d["programs_compiled"] >= 1
+    # trials beyond the shape buckets must hit the program cache
+    assert d["program_cache_hits"] >= 1
+    assert d["advisor_s_per_trial_at_30obs"] >= 0
+    assert "estimate" in d["baseline_basis"].lower()
+
+
+def test_bench_forced_failure_still_emits_json():
+    rc, out = _run({"RAFIKI_BENCH_SELFTEST_FAIL": "1"}, timeout=120)
+    assert rc == 1
+    assert "error" in out and "forced backend failure" in out["error"]
+    assert out["metric"] == "cifar10_automl_trials_per_hour"
+    assert out["value"] == 0.0
+
+
+def test_bench_deadline_watchdog_emits_json():
+    # The selftest stall (after backend init) guarantees the 10s
+    # watchdog fires mid-run regardless of cache warmth.
+    rc, out = _run({"RAFIKI_BENCH_PLATFORM": "cpu",
+                    "RAFIKI_BENCH_DEADLINE_S": "10",
+                    "RAFIKI_BENCH_SELFTEST_SLEEP_S": "60"}, timeout=180)
+    assert rc == 3
+    assert "deadline exceeded" in out["error"]
+    # partial detail survived
+    assert "device" in out["detail"]
