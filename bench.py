@@ -70,22 +70,36 @@ _emitted = False
 def _emit(error: str | None = None) -> None:
     """Print the single JSON result line exactly once. The lock makes
     the watchdog wait out an in-flight normal emit instead of racing it
-    (two lines / a truncated line would break the driver's parse)."""
+    (two lines / a truncated line would break the driver's parse).
+    _emitted flips only AFTER a successful print: the watchdog can fire
+    while the main thread is mutating detail, and a serialization error
+    here must not eat the one emission the driver parses."""
     global _emitted
     with _EMIT_LOCK:
         if _emitted:
             return
-        _emitted = True
         if error is not None:
             _OUT["error"] = error
-        print(json.dumps(_OUT), flush=True)
+        line = None
+        for _ in range(3):
+            try:
+                line = json.dumps(_OUT)
+                break
+            except RuntimeError:  # detail mutated mid-serialize; retry
+                time.sleep(0.05)
+        if line is None:  # last resort: drop the racing detail dict
+            line = json.dumps({k: v for k, v in _OUT.items() if k != "detail"})
+        print(line, flush=True)
+        _emitted = True
 
 
 def _watchdog(deadline_s: float):
     def fire():
-        _emit(error=f"deadline exceeded ({deadline_s:.0f}s); partial detail included")
-        # stdout is delivered; nothing graceful left to do.
-        os._exit(3)
+        try:
+            _emit(error=f"deadline exceeded ({deadline_s:.0f}s); partial detail included")
+        finally:
+            # stdout is delivered; nothing graceful left to do.
+            os._exit(3)
 
     t = threading.Timer(deadline_s, fire)
     t.daemon = True

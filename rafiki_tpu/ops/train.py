@@ -111,10 +111,22 @@ class _ShardingPlan:
     def put_batch(self, batch: Batch) -> Dict[str, jax.Array]:
         if self.batch_sharding is None:
             return {k: jnp.asarray(v) for k, v in batch.items()}
+        if not self.batch_sharding.is_fully_addressable:
+            # Mesh spans processes (multi-host dp): device_put cannot
+            # target non-addressable devices; materialize only this
+            # process's shards of the (identical-everywhere) batch.
+            from rafiki_tpu.parallel.multihost import global_put
+
+            return global_put(batch, self.batch_sharding)
         return {k: jax.device_put(v, self.batch_sharding) for k, v in batch.items()}
 
     def put_state(self, state):
         if self.state_sharding is None:
+            return state
+        if not self.state_sharding.is_fully_addressable:
+            # Multi-host: leave host leaves alone — jit treats host
+            # values as replicated, and device leaves were produced by
+            # the jitted init with the right global sharding already.
             return state
         return jax.device_put(state, self.state_sharding)
 

@@ -1,0 +1,58 @@
+"""Multi-host (DCN) helpers: one logical dp worker spanning processes.
+
+TPU pods put chips behind multiple hosts; JAX's model is SPMD — every
+process runs the same program over its local chips while XLA runs the
+collectives over ICI within a host and DCN across hosts
+(``jax.distributed.initialize`` in worker/main.py joins the cluster;
+the reference's NCCL/MPI role — SURVEY.md §5 comm-backend row).
+
+The control plane stays single-headed: process 0 of a worker group is
+the LEADER and runs the normal trial loop (meta store writes, advisor
+calls, params persistence); the other processes run
+``worker.follower.FollowerWorker``, which mirrors the leader's trials
+compute-for-compute so the collective steps line up. Helpers here are
+the small shared vocabulary for that split.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_multiprocess() -> bool:
+    return process_count() > 1
+
+
+def is_leader() -> bool:
+    import jax
+
+    return jax.process_index() == 0
+
+
+def global_put(batch: Dict[str, np.ndarray], sharding):
+    """Build global device arrays for a host batch whose full value is
+    known (identically) on every process.
+
+    ``jax.device_put`` cannot place onto a sharding with
+    non-addressable devices; ``make_array_from_callback`` materializes
+    only this process's shards. Determinism note: callers guarantee the
+    same host batch on every process (dataset iteration is seeded by
+    trial seed + epoch, so leader and followers draw identical
+    batches).
+    """
+    import jax
+
+    out = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        out[k] = jax.make_array_from_callback(
+            v.shape, sharding, lambda idx, v=v: v[idx])
+    return out

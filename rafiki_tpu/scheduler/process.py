@@ -39,6 +39,25 @@ from rafiki_tpu.store import MetaStore, ParamsStore
 from rafiki_tpu.utils.events import events
 
 
+def _free_ports(n: int) -> List[int]:
+    """n distinct free loopback ports: all probe sockets are held open
+    until every port is chosen, so the OS cannot hand the same port to
+    two groups (the residual race against unrelated processes between
+    close and the coordinator's bind is inherent and accepted)."""
+    import socket
+
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
 def worker_device_env(platform: str, worker_index: int,
                       devices_per_trial: int = 1) -> Dict[str, str]:
     """Env vars that pin a worker subprocess to its own device set."""
@@ -99,6 +118,7 @@ class ProcessScheduler:
         platform: Optional[str] = None,
         stop_event: Optional[threading.Event] = None,
         poll_s: float = 0.5,
+        multihost_processes: int = 1,
     ) -> TrainJobResult:
         t0 = time.time()
         job = self.store.get_train_job(job_id)
@@ -131,7 +151,8 @@ class ProcessScheduler:
                     continue
                 self._run_sub_job(sub, job, n_workers, devices_per_trial,
                                   advisor_kind, platform, advisor_url, secret,
-                                  stop_event, poll_s, errors)
+                                  stop_event, poll_s, errors,
+                                  multihost_processes=multihost_processes)
         except BaseException:
             # Never leave the job stuck in RUNNING: mark terminal, then
             # re-raise for the caller.
@@ -166,7 +187,7 @@ class ProcessScheduler:
                      devices_per_trial: int, advisor_kind: str, platform: str,
                      advisor_url: str, secret: str,
                      stop_event: threading.Event, poll_s: float,
-                     errors: List[str]) -> None:
+                     errors: List[str], multihost_processes: int = 1) -> None:
         sub_errors: List[str] = []  # this sub job's failures only
         model_row = self.store.get_model(sub["model_id"])
         try:  # validate the template before spending processes on it
@@ -186,36 +207,62 @@ class ProcessScheduler:
         import tempfile
 
         procs: List[subprocess.Popen] = []
-        services: List[dict] = []
+        proc_services: List[Optional[dict]] = []  # leader's service row or None
         out_files = []
+        ports = (_free_ports(n_workers) if multihost_processes > 1 else
+                 [None] * n_workers)
         for i in range(n_workers):
             service = self.store.create_service(
                 ServiceType.TRAIN_WORKER.value, job_id=job["id"],
                 worker_index=i, devices=[f"{platform}:{i}"])
-            env = dict(os.environ)
-            env.update(worker_device_env(platform, i, devices_per_trial))
-            env.update({
-                "RAFIKI_WORKER_DB": self.db_path,
-                "RAFIKI_WORKER_PARAMS_DIR": self.params_dir,
-                "RAFIKI_WORKER_SUB_JOB_ID": sub["id"],
-                "RAFIKI_WORKER_ID": f"{job['id'][:8]}-p{i}",
-                "RAFIKI_WORKER_SERVICE_ID": service["id"],
-                "RAFIKI_WORKER_ADVISOR_URL": advisor_url,
-                "RAFIKI_WORKER_ADVISOR_ID": advisor_id,
-                "RAFIKI_WORKER_ADVISOR_SECRET": secret,
-            })
-            if events.path is not None:  # subprocess shares the event sink
-                env["RAFIKI_EVENTS_DIR"] = str(events.path.parent)
-            # Worker output goes to a temp file, not a pipe: a full pipe
-            # buffer would block the worker's writes and deadlock the
-            # supervise loop below.
-            out_f = tempfile.TemporaryFile(mode="w+t")
-            out_files.append(out_f)
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "rafiki_tpu.worker.main"],
-                env=env, stdout=out_f, stderr=subprocess.STDOUT, text=True)
-            procs.append(proc)
-            services.append(service)
+            # Multi-host dp group: N processes per worker — process 0
+            # leads (control plane), 1..N-1 follow (compute mirror,
+            # worker/follower.py) — coordinated via jax.distributed on
+            # a per-group loopback port (production pods use the pod's
+            # coordinator host; same env contract).
+            coordinator = (f"127.0.0.1:{ports[i]}"
+                           if multihost_processes > 1 else None)
+            leader_worker_id = f"{job['id'][:8]}-p{i}"
+            for j in range(multihost_processes):
+                env = dict(os.environ)
+                if not (platform == "tpu" and multihost_processes > 1):
+                    env.update(worker_device_env(
+                        platform, i * multihost_processes + j, devices_per_trial))
+                # else: a real multi-host TPU group must keep the pod
+                # runtime's own topology env (TPU_WORKER_ID etc.) — a
+                # flat per-process chip index + single-process bounds
+                # would contradict the jax.distributed cluster.
+                env.update({
+                    "RAFIKI_WORKER_DB": self.db_path,
+                    "RAFIKI_WORKER_PARAMS_DIR": self.params_dir,
+                    "RAFIKI_WORKER_SUB_JOB_ID": sub["id"],
+                    "RAFIKI_WORKER_ID": leader_worker_id + (
+                        f".{j}" if multihost_processes > 1 and j > 0 else ""),
+                    "RAFIKI_WORKER_SERVICE_ID": service["id"] if j == 0 else "",
+                    "RAFIKI_WORKER_ADVISOR_URL": advisor_url,
+                    "RAFIKI_WORKER_ADVISOR_ID": advisor_id,
+                    "RAFIKI_WORKER_ADVISOR_SECRET": secret,
+                })
+                if coordinator is not None:
+                    env.update({
+                        "RAFIKI_COORDINATOR_ADDRESS": coordinator,
+                        "RAFIKI_NUM_PROCESSES": str(multihost_processes),
+                        "RAFIKI_PROCESS_ID": str(j),
+                        "RAFIKI_LEADER_WORKER_ID": leader_worker_id,
+                        "RAFIKI_LEADER_SERVICE_ID": service["id"],
+                    })
+                if events.path is not None:  # subprocess shares the event sink
+                    env["RAFIKI_EVENTS_DIR"] = str(events.path.parent)
+                # Worker output goes to a temp file, not a pipe: a full
+                # pipe buffer would block the worker's writes and
+                # deadlock the supervise loop below.
+                out_f = tempfile.TemporaryFile(mode="w+t")
+                out_files.append(out_f)
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "rafiki_tpu.worker.main"],
+                    env=env, stdout=out_f, stderr=subprocess.STDOUT, text=True)
+                procs.append(proc)
+                proc_services.append(service if j == 0 else None)
             self.store.update_service(service["id"],
                                       status=ServiceStatus.RUNNING.value)
 
@@ -233,17 +280,19 @@ class ProcessScheduler:
                 break
             time.sleep(poll_s)
 
-        for p, svc, out_f in zip(procs, services, out_files):
+        for k, (p, svc, out_f) in enumerate(zip(procs, proc_services, out_files)):
             rc = p.wait()
             out_f.seek(0)
             out = out_f.read()
             out_f.close()
             if rc != 0 and not stop_event.is_set():
-                sub_errors.append(
-                    f"worker {svc['worker_index']} rc={rc}: {out[-2000:]}")
-                self.store.update_service(svc["id"],
-                                          status=ServiceStatus.ERRORED.value)
-            else:
+                label = (f"worker {svc['worker_index']}" if svc is not None
+                         else f"follower proc {k}")
+                sub_errors.append(f"{label} rc={rc}: {out[-2000:]}")
+                if svc is not None:
+                    self.store.update_service(svc["id"],
+                                              status=ServiceStatus.ERRORED.value)
+            elif svc is not None:
                 self.store.update_service(svc["id"],
                                           status=ServiceStatus.STOPPED.value)
         errors.extend(sub_errors)

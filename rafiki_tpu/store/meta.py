@@ -488,6 +488,9 @@ class MetaStore:
             if heartbeat:
                 c.execute("UPDATE services SET heartbeat_at=? WHERE id=?", (_now(), service_id))
 
+    def get_service(self, service_id: str) -> Optional[dict]:
+        return self._one("SELECT * FROM services WHERE id=?", (service_id,))
+
     def get_services_of_job(self, job_id: str) -> List[dict]:
         return self._all("SELECT * FROM services WHERE job_id=?", (job_id,))
 

@@ -1,0 +1,135 @@
+"""Follower half of a multi-host dp worker group.
+
+In a worker group spanning N processes (one per host of a pod slice),
+process 0 — the leader — runs the ordinary ``TrainWorker`` trial loop:
+store writes, advisor propose/feedback, params persistence. Processes
+1..N-1 run this follower loop instead. SPMD requires every process to
+execute the SAME sequence of collective programs, so the follower
+mirrors each of the leader's trials compute-for-compute:
+
+  * it watches the shared meta store for trials of its sub-job
+    entering RUNNING (the leader creates the row BEFORE building the
+    model, so the follower can never miss a trial's collectives);
+  * for each, it builds the same model from the same knobs, joins the
+    same dp mesh over the global device set, and calls train+evaluate —
+    drawing identical batches (dataset iteration is seeded by trial
+    seed + epoch) and feeding its local shards of them;
+  * it performs NO store writes, NO advisor calls, NO persistence —
+    single-headed control plane, replicated data plane;
+  * it exits when the sub-job reaches a terminal status or the trial
+    budget is exhausted and nothing is running.
+
+Caveat (documented limitation): if the leader aborts a trial mid-epoch
+(worker crash, OOM), the follower is left inside a collective that the
+leader abandoned; the collective's transport timeout (gloo/DCN) then
+surfaces the failure here too, and the scheduler's group supervision
+restarts the whole group. Trial-level containment of *model* errors
+still works: the leader catches them between collective programs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from rafiki_tpu.constants import TrainJobStatus, TrialStatus
+from rafiki_tpu.model.base import load_model_class
+from rafiki_tpu.store import MetaStore
+
+_TERMINAL = {TrainJobStatus.COMPLETED.value, TrainJobStatus.ERRORED.value,
+             TrainJobStatus.STOPPED.value}
+
+
+class FollowerWorker:
+    def __init__(self, store: MetaStore, sub_train_job_id: str,
+                 leader_worker_id: Optional[str] = None,
+                 leader_service_id: Optional[str] = None,
+                 poll_s: float = 0.2):
+        self.store = store
+        self.sub_id = sub_train_job_id
+        # Scope to OUR group's leader: with several multihost worker
+        # groups on one sub-job, mirroring another group's trials would
+        # enter collectives our own leader never issues (deadlock).
+        self.leader_worker_id = leader_worker_id
+        self.leader_service_id = leader_service_id
+        self.poll_s = poll_s
+        self.mirrored = 0
+
+    def _budget_drained(self, job: dict, trials: list) -> bool:
+        budget = job.get("budget") or {}
+        max_trials = budget.get("MODEL_TRIAL_COUNT")
+        if max_trials is None:
+            return False
+        settled = [t for t in trials
+                   if t["status"] in (TrialStatus.COMPLETED.value,
+                                      TrialStatus.ERRORED.value)]
+        return len(settled) >= int(max_trials)
+
+    def run(self) -> int:
+        """Mirror trials until the job ends. Returns #trials mirrored."""
+        import jax
+
+        sub = self.store.get_sub_train_job(self.sub_id)
+        if sub is None:
+            raise KeyError(f"No sub train job {self.sub_id!r}")
+        job = self.store.get_train_job(sub["train_job_id"])
+        model_row = self.store.get_model(sub["model_id"])
+        model_cls = load_model_class(model_row["model_file"],
+                                     model_row["model_class"])
+        from rafiki_tpu.parallel.mesh import data_parallel_mesh
+
+        mesh = data_parallel_mesh(jax.devices())
+        seen = set()
+        while True:
+            trials = self.store.get_trials_of_sub_train_job(self.sub_id)
+            ran_one = False
+            for t in trials:
+                if t["id"] in seen or t["status"] != TrialStatus.RUNNING.value:
+                    continue
+                if (self.leader_worker_id is not None
+                        and t.get("worker_id") != self.leader_worker_id):
+                    continue  # another group's trial
+                seen.add(t["id"])
+                ran_one = True
+                model = model_cls(**t["knobs"])
+                if hasattr(model, "set_mesh"):
+                    model.set_mesh(mesh)
+                try:
+                    model.train(job["train_dataset_uri"])
+                    model.evaluate(job["val_dataset_uri"])
+                    self.mirrored += 1
+                except Exception:
+                    # The leader owns error handling; our job was only
+                    # to keep the collectives paired. If the model
+                    # itself raised, it raised identically on the
+                    # leader (same program, same data) before any
+                    # collective mismatch.
+                    pass
+                finally:
+                    model.destroy()
+            if ran_one:
+                continue  # look again immediately: the next trial may be up
+            sub = self.store.get_sub_train_job(self.sub_id)
+            if sub is None or sub["status"] in _TERMINAL:
+                break
+            if self._budget_drained(job, trials) and not any(
+                    t["status"] == TrialStatus.RUNNING.value for t in trials):
+                break
+            if self._leader_done():
+                # Covers budgets with no trial count (e.g. TIME_HOURS
+                # only): the leader marks its service row terminal
+                # before exiting; without this the follower would wait
+                # for a sub-job status the scheduler only writes after
+                # ALL group processes (including us) exit.
+                break
+            time.sleep(self.poll_s)
+        return self.mirrored
+
+    def _leader_done(self) -> bool:
+        if self.leader_service_id is None:
+            return False
+        from rafiki_tpu.constants import ServiceStatus
+
+        svc = self.store.get_service(self.leader_service_id)
+        return svc is None or svc["status"] in (
+            ServiceStatus.STOPPED.value, ServiceStatus.ERRORED.value)

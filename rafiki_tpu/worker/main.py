@@ -59,6 +59,8 @@ def main() -> int:
     # the jax.distributed cluster over DCN before touching devices —
     # this worker then sees its host's chips while collectives span the
     # pod (the reference's NCCL/MPI role is played by XLA here).
+    # Process 0 of the group is the control-plane leader; the rest
+    # mirror its trials compute-for-compute (worker/follower.py).
     coordinator = os.environ.get("RAFIKI_COORDINATOR_ADDRESS")
     if coordinator:
         jax.distributed.initialize(
@@ -66,15 +68,33 @@ def main() -> int:
             num_processes=int(os.environ["RAFIKI_NUM_PROCESSES"]),
             process_id=int(os.environ["RAFIKI_PROCESS_ID"]))
 
-    from rafiki_tpu.utils.events import configure_from_env
+    from rafiki_tpu.utils.events import configure_from_env, events
 
     configure_from_env()
 
-    from rafiki_tpu.advisor.app import HttpAdvisorHandle
     from rafiki_tpu.store import MetaStore, ParamsStore
-    from rafiki_tpu.worker.train import build_worker_from_store
 
     store = MetaStore(db_path)
+    if coordinator:
+        events.emit("multihost_init", worker_id=worker_id,
+                    process_id=jax.process_index(),
+                    process_count=jax.process_count(),
+                    global_devices=len(jax.devices()),
+                    local_devices=len(jax.local_devices()))
+        if jax.process_index() != 0:
+            from rafiki_tpu.worker.follower import FollowerWorker
+
+            n = FollowerWorker(
+                store, sub_job_id,
+                leader_worker_id=os.environ.get("RAFIKI_LEADER_WORKER_ID"),
+                leader_service_id=os.environ.get("RAFIKI_LEADER_SERVICE_ID"),
+            ).run()
+            print(f"follower {worker_id}: mirrored {n} trials", flush=True)
+            return 0
+
+    from rafiki_tpu.advisor.app import HttpAdvisorHandle
+    from rafiki_tpu.worker.train import build_worker_from_store
+
     params_store = ParamsStore(params_dir)
     advisor = HttpAdvisorHandle(advisor_url, advisor_id, secret=secret)
     worker = build_worker_from_store(
@@ -82,6 +102,14 @@ def main() -> int:
         worker_id=worker_id, devices=jax.devices())
     worker.service_id = service_id
     n = worker.run()
+    if coordinator and service_id:
+        # Tell our followers we're done BEFORE exiting: the scheduler
+        # only writes terminal sub-job status after ALL group processes
+        # exit, so a follower waiting on that would deadlock the group
+        # under budgets with no trial count (e.g. TIME_HOURS only).
+        from rafiki_tpu.constants import ServiceStatus
+
+        store.update_service(service_id, status=ServiceStatus.STOPPED.value)
     print(f"worker {worker_id}: ran {n} trials", flush=True)
     return 0
 

@@ -34,21 +34,50 @@ def test_random_advisor_proposals_valid():
         assert knobs["fixed"] == 42
 
 
+def _hard_config():
+    return {
+        "x": FloatKnob(-2.0, 2.0),
+        "y": FloatKnob(1e-3, 1e1, is_exp=True),
+        "z": FloatKnob(0.0, 1.0),
+        "n": IntegerKnob(1, 8),
+        "c": CategoricalKnob(["a", "b"]),
+        "fixed": FixedKnob(42),
+    }
+
+
+def _hard_objective(k):
+    # Narrow smooth peak (x=0.5, y=1.0, z=0.3, n=4, c='b'), max 0.5:
+    # narrow enough that 40 random draws rarely land near it, smooth
+    # enough that a working GP reliably climbs to it.
+    return (
+        -3.0 * (k["x"] - 0.5) ** 2
+        - 1.5 * np.log10(k["y"]) ** 2
+        - 4.0 * (k["z"] - 0.3) ** 2
+        - 0.08 * (k["n"] - 4) ** 2
+        + (0.5 if k["c"] == "b" else 0.0)
+    )
+
+
 def test_gp_advisor_beats_random():
-    """GP should find a better optimum than random search on a smooth
-    objective with the same budget (the reference's raison d'être)."""
-    budget = 30
+    """GP must find a STRICTLY better optimum than random search with
+    the same budget — by a margin, so this fails if the GP is swapped
+    for (or degrades to) random sampling. Calibrated over 6 seeds:
+    GP mean ~0.49 (worst seed 0.48), random mean ~-0.28 (best seed
+    0.32); the 0.3 margin sits well inside the gap."""
+    budget = 40
     results = {}
-    for kind, seed_offset in (("gp", 0), ("random", 0)):
+    for kind in ("gp", "random"):
         bests = []
-        for seed in range(3):
-            adv = make_advisor(_config(), kind=kind, seed=seed + seed_offset)
+        for seed in range(6):
+            adv = make_advisor(_hard_config(), kind=kind, seed=seed)
             for _ in range(budget):
                 knobs = adv.propose()
-                adv.feedback(_objective(knobs), knobs)
+                adv.feedback(_hard_objective(knobs), knobs)
             bests.append(adv.best()[1])
-        results[kind] = np.mean(bests)
-    assert results["gp"] >= results["random"] - 0.05, results
+        results[kind] = float(np.mean(bests))
+    assert results["gp"] >= results["random"] + 0.3, results
+    # and the GP actually solves the problem, not merely beats random
+    assert results["gp"] >= 0.4, results
 
 
 def test_gp_pending_points_drain():

@@ -45,19 +45,35 @@ def test_device_env_tpu():
     assert env2["TPU_VISIBLE_CHIPS"] == "2,3"
 
 
-def test_process_train_job(env):
-    store, params, model = env
-    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 3})
+SLOW_FF_SOURCE = FF_SOURCE.replace(
+    b"class TinyFF(JaxModel):",
+    b"""class SlowFF(JaxModel):
+    def train(self, uri):
+        import time
+        time.sleep(1.0)  # outlast subprocess startup skew
+        super().train(uri)
+""",
+).replace(b'"TinyFF"', b'"SlowFF"')
+
+
+def test_process_train_job(env, tmp_path):
+    """BOTH subprocess workers must really run trials (budget shared
+    via the sqlite atomic claim): each trial sleeps 1s, so one worker
+    cannot drain the 8-trial budget during the other's startup skew
+    (both spawn concurrently; skew between them is well under 8s)."""
+    store, params, _ = env
+    model = store.create_model("slowff", "IMAGE_CLASSIFICATION", None,
+                               SLOW_FF_SOURCE, "SlowFF")
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 8})
     sched = ProcessScheduler(store, params)
     result = sched.run_train_job(job["id"], n_workers=2,
                                  advisor_kind="random", platform="cpu")
     assert result.status == "COMPLETED", result.errors
-    assert len(result.trials) == 3
+    assert len(result.trials) == 8
     completed = [t for t in result.trials if t["status"] == "COMPLETED"]
-    assert len(completed) == 3
-    # both subprocesses really ran trials (budget shared via sqlite claim)
+    assert len(completed) == 8
     workers = {t["worker_id"] for t in completed}
-    assert len(workers) >= 1
+    assert len(workers) == 2, f"budget drained by one worker: {workers}"
     # params written by the subprocess are loadable here
     best = result.best_trials[0]
     assert len(params.load(best["params_id"])) > 100
