@@ -21,17 +21,8 @@ from typing import Dict
 import numpy as np
 
 
-def process_count() -> int:
-    import jax
-
-    return jax.process_count()
-
-
-def is_multiprocess() -> bool:
-    return process_count() > 1
-
-
 def is_leader() -> bool:
+    """Process 0 of the jax.distributed cluster owns the control plane."""
     import jax
 
     return jax.process_index() == 0

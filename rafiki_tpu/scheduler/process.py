@@ -136,7 +136,10 @@ class ProcessScheduler:
         budget = dict(job["budget"])
         chip_budget = budget.get("CHIP_COUNT") or budget.get("GPU_COUNT")
         if chip_budget:
-            n_workers = min(n_workers, max(1, int(chip_budget) // devices_per_trial))
+            # Each worker group consumes devices_per_trial chips on EACH
+            # of its multihost processes.
+            per_group = devices_per_trial * max(1, multihost_processes)
+            n_workers = min(n_workers, max(1, int(chip_budget) // per_group))
 
         server, thread, secret, advisor_url = self._start_advisor_server()
         errors: List[str] = []

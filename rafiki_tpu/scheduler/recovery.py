@@ -52,16 +52,22 @@ def recover_orphaned_trials(
     """
     orphans = orphans if orphans is not None \
         else store.get_orphaned_trials(stale_after_s, sub_train_job_id)
-    # Claim every orphan up front (rebind to a live service) so a sweep
-    # racing this one finds no orphans left to double-adopt.
+    # Claim every orphan up front via the atomic compare-and-swap
+    # (status + observed owner): a sweep racing this one loses the CAS
+    # on any trial we win, so each orphan is adopted exactly once.
     claimed = []
     for trial in orphans:
-        events.emit("trial_orphan_detected", trial_id=trial["id"],
-                    worker_id=trial.get("worker_id"))
         service = store.create_service(ServiceType.TRAIN_WORKER.value)
         worker_id = f"recovery-{trial['id'][:8]}"
-        store.mark_trial_as_running(trial["id"], service_id=service["id"],
-                                    worker_id=worker_id)
+        if not store.adopt_trial(trial["id"], trial.get("service_id"),
+                                 service["id"], worker_id):
+            # Lost the race (another sweep adopted it, or the original
+            # worker finished after all) — leave it alone.
+            store.update_service(service["id"],
+                                 status=ServiceStatus.STOPPED.value)
+            continue
+        events.emit("trial_orphan_detected", trial_id=trial["id"],
+                    worker_id=trial.get("worker_id"))
         store.update_service(service["id"], heartbeat=True)
         claimed.append((trial, service, worker_id))
 

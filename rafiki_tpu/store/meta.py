@@ -358,6 +358,26 @@ class MetaStore:
                 (TrialStatus.RUNNING.value, _now(), service_id, worker_id,
                  trial_id))
 
+    def adopt_trial(self, trial_id: str, prev_service_id: Optional[str],
+                    service_id: str, worker_id: str) -> bool:
+        """Atomically take ownership of an orphaned RUNNING trial.
+
+        Compare-and-swap on (status, service_id): succeeds only if the
+        trial is still RUNNING and still bound to the service the sweep
+        observed, so (a) two concurrent recovery sweeps adopt each
+        orphan exactly once — the loser's UPDATE matches zero rows —
+        and (b) a zombie worker that finished the trial in the meantime
+        keeps its terminal status (no COMPLETED -> RUNNING regression).
+        """
+        with self._conn() as c:
+            cur = c.execute(
+                "UPDATE trials SET status=?, error=NULL, stopped_at=NULL,"
+                " started_at=?, service_id=?, worker_id=?"
+                " WHERE id=? AND status=? AND service_id IS ?",
+                (TrialStatus.RUNNING.value, _now(), service_id, worker_id,
+                 trial_id, TrialStatus.RUNNING.value, prev_service_id))
+            return cur.rowcount > 0
+
     def mark_trial_as_terminated(self, trial_id: str) -> None:
         with self._conn() as c:
             c.execute("UPDATE trials SET status=?, stopped_at=? WHERE id=?",

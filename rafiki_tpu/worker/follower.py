@@ -91,10 +91,16 @@ class FollowerWorker:
                     continue  # another group's trial
                 seen.add(t["id"])
                 ran_one = True
-                model = model_cls(**t["knobs"])
-                if hasattr(model, "set_mesh"):
-                    model.set_mesh(mesh)
+                model = None
                 try:
+                    # Construction stays INSIDE the containment: a
+                    # knob-dependent constructor error raises on the
+                    # leader too (same class, same knobs) and must not
+                    # kill this process — a dead follower stalls the
+                    # group at the next collective.
+                    model = model_cls(**t["knobs"])
+                    if hasattr(model, "set_mesh"):
+                        model.set_mesh(mesh)
                     model.train(job["train_dataset_uri"])
                     model.evaluate(job["val_dataset_uri"])
                     self.mirrored += 1
@@ -106,7 +112,8 @@ class FollowerWorker:
                     # collective mismatch.
                     pass
                 finally:
-                    model.destroy()
+                    if model is not None:
+                        model.destroy()
             if ran_one:
                 continue  # look again immediately: the next trial may be up
             sub = self.store.get_sub_train_job(self.sub_id)

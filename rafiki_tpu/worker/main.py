@@ -81,7 +81,9 @@ def main() -> int:
                     process_count=jax.process_count(),
                     global_devices=len(jax.devices()),
                     local_devices=len(jax.local_devices()))
-        if jax.process_index() != 0:
+        from rafiki_tpu.parallel.multihost import is_leader
+
+        if not is_leader():
             from rafiki_tpu.worker.follower import FollowerWorker
 
             n = FollowerWorker(
@@ -101,15 +103,19 @@ def main() -> int:
         store, params_store, sub_job_id, advisor,
         worker_id=worker_id, devices=jax.devices())
     worker.service_id = service_id
-    n = worker.run()
-    if coordinator and service_id:
-        # Tell our followers we're done BEFORE exiting: the scheduler
-        # only writes terminal sub-job status after ALL group processes
-        # exit, so a follower waiting on that would deadlock the group
-        # under budgets with no trial count (e.g. TIME_HOURS only).
-        from rafiki_tpu.constants import ServiceStatus
+    try:
+        n = worker.run()
+    finally:
+        if coordinator and service_id:
+            # Tell our followers we're done BEFORE exiting — on the
+            # crash path too: the scheduler only writes terminal
+            # sub-job status after ALL group processes exit, so a
+            # follower waiting on that (or on a service row a dead
+            # leader never updated) would deadlock the group.
+            from rafiki_tpu.constants import ServiceStatus
 
-        store.update_service(service_id, status=ServiceStatus.STOPPED.value)
+            store.update_service(service_id,
+                                 status=ServiceStatus.STOPPED.value)
     print(f"worker {worker_id}: ran {n} trials", flush=True)
     return 0
 

@@ -1,0 +1,209 @@
+"""Property-based scheduler invariants under randomized interleavings.
+
+SURVEY.md §5: the reference rests its concurrency correctness on
+Postgres transactions; the rebuild's prescription is "property tests on
+scheduler invariants instead". These tests hammer the meta store's
+claim / heartbeat / recover primitives from many threads with seeded
+random interleavings and assert the three invariants that hold the
+AutoML loop together:
+
+  1. BUDGET — the number of trials created never exceeds the job's
+     trial budget, no matter how many workers race the claim;
+  2. EXACTLY-ONCE ADOPTION — concurrent recovery sweeps never
+     double-adopt an orphan (atomic CAS on status + observed owner);
+  3. NO TERMINAL REGRESSION — a COMPLETED/ERRORED trial never goes
+     back to RUNNING (a zombie sweep cannot resurrect a finished
+     trial).
+"""
+
+import random
+import threading
+
+import pytest
+
+from rafiki_tpu.store import MetaStore
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return MetaStore(tmp_path / "meta.sqlite3")
+
+
+def _job(store, budget):
+    model = store.create_model("m", "IMAGE_CLASSIFICATION", None, b"x=1", "X")
+    job = store.create_train_job("app", "IMAGE_CLASSIFICATION", None,
+                                 "t", "v", {"MODEL_TRIAL_COUNT": budget})
+    sub = store.create_sub_train_job(job["id"], model["id"])
+    return job, sub, model
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_budget_never_exceeded_under_racing_claims(store, seed):
+    budget = 23
+    job, sub, model = _job(store, budget)
+    rng = random.Random(seed)
+    n_workers = 8
+    barrier = threading.Barrier(n_workers)
+    claimed_counts = [0] * n_workers
+
+    def worker(w):
+        barrier.wait()  # maximal contention at the first claim
+        while store.claim_trial_slot(sub["id"], budget):
+            t = store.create_trial(sub["id"], "X", {"k": w}, worker_id=f"w{w}",
+                                   service_id=None)
+            claimed_counts[w] += 1
+            if rng.random() < 0.5:
+                store.mark_trial_as_completed(t["id"], rng.random(), None)
+            else:
+                store.mark_trial_as_errored(t["id"], "boom")
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(n_workers)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+    trials = store.get_trials_of_sub_train_job(sub["id"])
+    assert len(trials) == budget  # never over, and the budget drains fully
+    assert sum(claimed_counts) == budget
+    # trial numbering stayed dense and unique under contention
+    assert sorted(t["no"] for t in trials) == list(range(1, budget + 1))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_orphan_adoption_is_exactly_once(store, seed):
+    """k sweeper threads race over the same orphan set; the CAS must
+    hand each orphan to exactly one sweeper."""
+    budget = 40
+    job, sub, model = _job(store, budget)
+    rng = random.Random(seed)
+    orphan_ids = []
+    for i in range(budget):
+        svc = store.create_service("TRAIN_WORKER")
+        # dead service -> its RUNNING trial is an orphan
+        store.update_service(svc["id"], status="ERRORED")
+        t = store.create_trial(sub["id"], "X", {"i": i}, worker_id=f"dead{i}",
+                               service_id=svc["id"])
+        orphan_ids.append(t["id"])
+
+    orphans = store.get_orphaned_trials(stale_after_s=0.0)
+    assert {t["id"] for t in orphans} == set(orphan_ids)
+
+    n_sweepers = 6
+    adopted = [[] for _ in range(n_sweepers)]
+    barrier = threading.Barrier(n_sweepers)
+
+    def sweeper(s):
+        my_orphans = list(orphans)
+        rng_local = random.Random(seed * 100 + s)
+        rng_local.shuffle(my_orphans)
+        barrier.wait()
+        for t in my_orphans:
+            svc = store.create_service("TRAIN_WORKER")
+            if store.adopt_trial(t["id"], t["service_id"], svc["id"], f"rec-s{s}"):
+                adopted[s].append(t["id"])
+
+    threads = [threading.Thread(target=sweeper, args=(s,)) for s in range(n_sweepers)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+    all_adopted = [tid for lst in adopted for tid in lst]
+    assert len(all_adopted) == len(set(all_adopted)), "an orphan was double-adopted"
+    assert set(all_adopted) == set(orphan_ids)  # none lost, none duplicated
+
+
+def test_terminal_status_never_regresses(store):
+    """A zombie sweep holding a stale orphan observation cannot flip a
+    since-finished trial back to RUNNING."""
+    budget = 10
+    job, sub, model = _job(store, budget)
+    svc = store.create_service("TRAIN_WORKER")
+    store.update_service(svc["id"], status="ERRORED")
+    t = store.create_trial(sub["id"], "X", {}, worker_id="w0",
+                           service_id=svc["id"])
+    # sweep observes the orphan...
+    orphans = store.get_orphaned_trials(stale_after_s=0.0)
+    assert [o["id"] for o in orphans] == [t["id"]]
+    # ...but the original worker was merely slow, not dead: it finishes
+    store.mark_trial_as_completed(t["id"], 0.91, None)
+    # the stale sweep's adoption must now fail
+    rec = store.create_service("TRAIN_WORKER")
+    assert not store.adopt_trial(t["id"], svc["id"], rec["id"], "rec")
+    assert store.get_trial(t["id"])["status"] == "COMPLETED"
+    assert store.get_trial(t["id"])["score"] == 0.91
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_randomized_lifecycle_interleaving(store, seed):
+    """Free-for-all: workers claim/complete/die, sweepers adopt and
+    finish, heartbeats interleave. Afterwards every invariant holds and
+    every trial is terminal."""
+    budget = 30
+    job, sub, model = _job(store, budget)
+    stop = threading.Event()
+    status_log = {}  # trial_id -> list of observed statuses
+    log_lock = threading.Lock()
+
+    def worker(w):
+        rng = random.Random(seed * 31 + w)
+        while store.claim_trial_slot(sub["id"], budget):
+            svc = store.create_service("TRAIN_WORKER")
+            t = store.create_trial(sub["id"], "X", {"w": w}, worker_id=f"w{w}",
+                                   service_id=svc["id"])
+            for _ in range(rng.randrange(3)):
+                store.update_service(svc["id"], heartbeat=True)
+            r = rng.random()
+            if r < 0.45:
+                store.mark_trial_as_completed(t["id"], rng.random(), None)
+                store.update_service(svc["id"], status="STOPPED")
+            elif r < 0.7:
+                store.mark_trial_as_errored(t["id"], "boom")
+                store.update_service(svc["id"], status="STOPPED")
+            else:  # die mid-trial: leave RUNNING with a dead service
+                store.update_service(svc["id"], status="ERRORED")
+
+    def sweeper(s):
+        rng = random.Random(seed * 97 + s)
+        while not stop.is_set():
+            for t in store.get_orphaned_trials(stale_after_s=0.0):
+                svc = store.create_service("TRAIN_WORKER")
+                if store.adopt_trial(t["id"], t["service_id"], svc["id"], f"rec{s}"):
+                    # "re-run" then finish
+                    store.mark_trial_as_completed(t["id"], rng.random(), None)
+                    store.update_service(svc["id"], status="STOPPED")
+
+    def monitor():
+        while not stop.is_set():
+            for t in store.get_trials_of_sub_train_job(sub["id"]):
+                with log_lock:
+                    hist = status_log.setdefault(t["id"], [])
+                    if not hist or hist[-1] != t["status"]:
+                        hist.append(t["status"])
+
+    workers = [threading.Thread(target=worker, args=(w,)) for w in range(6)]
+    sweepers = [threading.Thread(target=sweeper, args=(s,)) for s in range(2)]
+    mon = threading.Thread(target=monitor)
+    for th in workers + sweepers + [mon]:
+        th.start()
+    for th in workers:
+        th.join()
+    # let sweepers drain remaining orphans
+    deadline = threading.Event()
+    for _ in range(200):
+        if not store.get_orphaned_trials(stale_after_s=0.0):
+            break
+        deadline.wait(0.05)
+    stop.set()
+    for th in sweepers + [mon]:
+        th.join()
+
+    trials = store.get_trials_of_sub_train_job(sub["id"])
+    assert len(trials) == budget
+    assert all(t["status"] in ("COMPLETED", "ERRORED") for t in trials)
+    # no observed terminal -> non-terminal transition
+    terminal = {"COMPLETED", "ERRORED"}
+    for tid, hist in status_log.items():
+        for a, b in zip(hist, hist[1:]):
+            assert not (a in terminal and b == "RUNNING"), (tid, hist)
