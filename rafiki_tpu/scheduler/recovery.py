@@ -71,16 +71,45 @@ def recover_orphaned_trials(
         store.update_service(service["id"], heartbeat=True)
         claimed.append((trial, service, worker_id))
 
+    # Keep every still-QUEUED claim's heartbeat fresh while earlier
+    # re-runs execute: with one initial heartbeat only, a claim queued
+    # behind a re-run longer than stale_after_s would go stale and a
+    # periodic sweep's CAS (holding the CURRENT owner) would adopt it
+    # again — two concurrent re-runs of one trial.
+    import threading
+
+    pending_services = {svc["id"] for _, svc, _ in claimed}
+    pending_lock = threading.Lock()
+    stop_beat = threading.Event()
+
+    def _beat():
+        interval = max(0.05, min(stale_after_s / 4.0, 5.0))
+        while not stop_beat.wait(interval):
+            with pending_lock:
+                ids = list(pending_services)
+            for sid in ids:
+                store.update_service(sid, heartbeat=True)
+
+    beater = threading.Thread(target=_beat, name="recovery-heartbeat",
+                              daemon=True)
+    beater.start()
     results: List[dict] = []
-    for trial, service, worker_id in claimed:
-        worker = build_worker_from_store(
-            store, params_store, trial["sub_train_job_id"],
-            advisor or _RecoveryAdvisor(),
-            worker_id=worker_id, devices=devices,
-            async_persist=False)  # recovery is synchronous; no saver thread
-        worker.service_id = service["id"]
-        try:
-            results.append(worker.resume_trial(trial["id"]))
-        finally:
-            store.update_service(service["id"], status=ServiceStatus.STOPPED.value)
+    try:
+        for trial, service, worker_id in claimed:
+            worker = build_worker_from_store(
+                store, params_store, trial["sub_train_job_id"],
+                advisor or _RecoveryAdvisor(),
+                worker_id=worker_id, devices=devices,
+                async_persist=False)  # recovery is synchronous; no saver thread
+            worker.service_id = service["id"]
+            try:
+                results.append(worker.resume_trial(trial["id"]))
+            finally:
+                with pending_lock:
+                    pending_services.discard(service["id"])
+                store.update_service(service["id"],
+                                     status=ServiceStatus.STOPPED.value)
+    finally:
+        stop_beat.set()
+        beater.join(timeout=5)
     return results

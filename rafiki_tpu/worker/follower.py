@@ -112,8 +112,11 @@ class FollowerWorker:
                     # collective mismatch.
                     pass
                 finally:
-                    if model is not None:
-                        model.destroy()
+                    try:
+                        if model is not None:
+                            model.destroy()
+                    except Exception:
+                        pass  # user-model destroy() must not kill the group
             if ran_one:
                 continue  # look again immediately: the next trial may be up
             sub = self.store.get_sub_train_job(self.sub_id)

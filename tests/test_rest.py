@@ -169,6 +169,13 @@ def test_web_ui_served(server):
     assert resp.status_code == 200
     assert "text/html" in resp.headers["Content-Type"]
     assert "rafiki-tpu" in resp.text and "login-form" in resp.text
+    # the parity surfaces: per-trial metric plots (define_plot channel),
+    # trial-log viewer, stop controls for train + inference jobs
+    for marker in ("renderTrial", "linePlot", "Trial log", "stop-job",
+                   "stop-inf", "</html>"):
+        assert marker in resp.text, f"web UI missing {marker!r}"
+    # balanced script block (a truncated inline script serves silently)
+    assert resp.text.count("<script>") == resp.text.count("</script>") == 1
 
 
 def test_404s(server, superadmin):
