@@ -160,6 +160,9 @@ class JaxModel(BaseModel):
         return optax.adam(sched)
 
     def preprocess(self, x: np.ndarray) -> np.ndarray:
+        """Optional input transform. MUST NOT modify ``x`` in place —
+        datasets are cached and shared across trials (dataset_utils);
+        return a new array (e.g. ``x / 255.0``, not ``x /= 255.0``)."""
         return x
 
     def loss(self, params, batch, rng, apply_fn):

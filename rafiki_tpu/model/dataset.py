@@ -262,9 +262,55 @@ def _resolve_path(uri: str) -> str:
 # ---------------------------------------------------------------------------
 
 class DatasetUtils:
-    """URI front door, mirroring the reference's ``dataset_utils`` object."""
+    """URI front door, mirroring the reference's ``dataset_utils`` object.
+
+    Loads are cached process-wide (small LRU, keyed by URI + file mtime
+    for local paths): a train worker loads the SAME dataset URI once
+    per trial, and regenerating a CIFAR-scale synthetic set (~600MB of
+    RNG) or re-decoding a zip costs about as much as a warm trial's
+    entire compute — a straight trials/hour tax. Datasets are treated
+    as immutable by every consumer (templates wrap them in new
+    ``Dataset`` views; ``batches()`` shuffles indices, not arrays).
+    """
+
+    _CACHE_CAP = 4  # datasets can be ~GBs; keep the working set tight
+
+    def __init__(self):
+        import threading
+
+        self._cache: "dict" = {}  # key -> Dataset; insertion order = LRU
+        self._lock = threading.Lock()
+
+    def _cache_key(self, uri: str):
+        if uri.startswith("synthetic://"):
+            return uri  # fully determined by the URI itself
+        path = _resolve_path(uri)
+        try:
+            return (uri, os.path.getmtime(path))  # changed file = new key
+        except OSError:
+            return None  # missing/odd path: let _load raise, uncached
 
     def load(self, uri: str) -> Dataset:
+        key = self._cache_key(uri)
+        if key is not None:
+            with self._lock:
+                ds = self._cache.get(key)
+                if ds is not None:
+                    self._cache[key] = self._cache.pop(key)  # refresh LRU
+                    return ds
+        ds = self._load(uri)
+        if key is not None:
+            with self._lock:
+                self._cache[key] = ds
+                while len(self._cache) > self._CACHE_CAP:
+                    self._cache.pop(next(iter(self._cache)))
+        return ds
+
+    def clear_cache(self) -> None:
+        with self._lock:
+            self._cache.clear()
+
+    def _load(self, uri: str) -> Dataset:
         if uri.startswith("synthetic://"):
             parsed = urllib.parse.urlparse(uri)
             q = {k: int(v[0]) if v[0].lstrip("-").isdigit() else float(v[0])

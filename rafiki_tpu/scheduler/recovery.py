@@ -102,11 +102,15 @@ def recover_orphaned_trials(
                 worker_id=worker_id, devices=devices,
                 async_persist=False)  # recovery is synchronous; no saver thread
             worker.service_id = service["id"]
+            # Hand heartbeat duty over to the worker's own progress-
+            # coupled epoch sink BEFORE the re-run starts: if the
+            # re-run hangs, its heartbeat must go stale so a periodic
+            # sweep can re-adopt — the beater only covers QUEUED claims.
+            with pending_lock:
+                pending_services.discard(service["id"])
             try:
                 results.append(worker.resume_trial(trial["id"]))
             finally:
-                with pending_lock:
-                    pending_services.discard(service["id"])
                 store.update_service(service["id"],
                                      status=ServiceStatus.STOPPED.value)
     finally:

@@ -19,7 +19,8 @@ Device pinning is inherited from the environment the scheduler set
 only its own chips, giving each trial an isolated XLA runtime — the
 TPU-native answer to the reference's one-GPU-per-container isolation.
 
-Exit codes: 0 = budget exhausted cleanly, 1 = crash.
+Exit codes: 0 = budget exhausted cleanly, 1 = crash,
+17 = backend-init watchdog timeout (TPU runtime unreachable).
 """
 
 from __future__ import annotations
@@ -47,6 +48,24 @@ def main() -> int:
 
         force_cpu_backend()
 
+    # Backend-init watchdog: jax blocks indefinitely when the TPU
+    # runtime is unreachable; a silent hang would stall the scheduler's
+    # supervise loop with no diagnosis. Exit with a structured error
+    # instead (the scheduler records it on the service row).
+    import threading
+
+    init_timeout = float(os.environ.get("RAFIKI_BACKEND_INIT_TIMEOUT_S", "180"))
+
+    def _init_stuck():
+        print(f"worker {worker_id}: FATAL backend init exceeded "
+              f"{init_timeout:.0f}s (TPU runtime unreachable?) — exiting",
+              flush=True)
+        os._exit(17)
+
+    watchdog = threading.Timer(init_timeout, _init_stuck)
+    watchdog.daemon = True
+    watchdog.start()
+
     # Persistent XLA compilation cache: a restarted (or sibling) worker
     # loads executables compiled by any previous process instead of
     # recompiling — the cross-process half of compile amortization (the
@@ -67,6 +86,9 @@ def main() -> int:
             coordinator_address=coordinator,
             num_processes=int(os.environ["RAFIKI_NUM_PROCESSES"]),
             process_id=int(os.environ["RAFIKI_PROCESS_ID"]))
+
+    jax.devices()  # force backend init under the watchdog
+    watchdog.cancel()
 
     from rafiki_tpu.utils.events import configure_from_env, events
 

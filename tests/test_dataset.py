@@ -70,3 +70,38 @@ def test_npz_round_trip(tmp_path):
     ds2 = dataset_utils.load(path)
     assert ds2.size == 32
     np.testing.assert_allclose(ds.x, ds2.x, atol=1e-6)
+
+
+def test_dataset_load_is_cached(tmp_path):
+    """Same URI loads once per process (trials reload every trial; a
+    CIFAR-scale regeneration costs as much as a warm trial's compute)."""
+    from rafiki_tpu.model.dataset import dataset_utils
+
+    uri = "synthetic://images?classes=3&n=64&w=8&h=8&seed=0"
+    a = dataset_utils.load(uri)
+    b = dataset_utils.load(uri)
+    assert a is b  # cache hit: identical object
+    assert dataset_utils.load(
+        "synthetic://images?classes=3&n=64&w=8&h=8&seed=1") is not a
+
+
+def test_dataset_cache_invalidated_by_file_mtime(tmp_path):
+    import os
+    import time
+
+    import numpy as np
+
+    from rafiki_tpu.model.dataset import dataset_utils
+
+    p = tmp_path / "d.npz"
+    np.savez(p, x=np.zeros((4, 4, 4, 1), np.float32),
+             y=np.arange(4, dtype=np.int32))
+    a = dataset_utils.load(str(p))
+    assert dataset_utils.load(str(p)) is a
+    # rewrite the file with a newer mtime -> fresh load
+    np.savez(p, x=np.ones((4, 4, 4, 1), np.float32),
+             y=np.arange(4, dtype=np.int32))
+    os.utime(p, (time.time() + 5, time.time() + 5))
+    b = dataset_utils.load(str(p))
+    assert b is not a
+    assert float(b.x.max()) == 1.0

@@ -100,6 +100,35 @@ def test_multihost_time_budget_terminates(env):
     assert result.status == "COMPLETED", result.errors
 
 
+def test_backend_init_watchdog_exits_structured(tmp_path):
+    """A worker whose backend init hangs (dead TPU tunnel / unreachable
+    coordinator) must exit with a structured error instead of stalling
+    the scheduler's supervise loop forever (BENCH_r01's failure mode,
+    worker edition)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "RAFIKI_WORKER_DB": str(tmp_path / "meta.sqlite3"),
+        "RAFIKI_WORKER_PARAMS_DIR": str(tmp_path / "params"),
+        "RAFIKI_WORKER_SUB_JOB_ID": "nope",
+        "RAFIKI_WORKER_ADVISOR_URL": "http://127.0.0.1:1",
+        "RAFIKI_WORKER_ADVISOR_ID": "nope",
+        # coordinator that will never answer -> distributed init blocks
+        "RAFIKI_COORDINATOR_ADDRESS": "127.0.0.1:1",
+        "RAFIKI_NUM_PROCESSES": "2",
+        "RAFIKI_PROCESS_ID": "1",
+        "RAFIKI_BACKEND_INIT_TIMEOUT_S": "3",
+    })
+    r = subprocess.run([sys.executable, "-m", "rafiki_tpu.worker.main"],
+                       env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 17
+    assert "backend init exceeded" in r.stdout
+
+
 def test_multihost_stop_event(env):
     """Stopping a multihost job terminates leader AND followers."""
     store, params, model = env
