@@ -12,6 +12,8 @@ process (skopt is not available in this environment).
 from rafiki_tpu.advisor.base import BaseAdvisor, make_advisor
 from rafiki_tpu.advisor.random_advisor import RandomAdvisor
 from rafiki_tpu.advisor.gp import GpAdvisor
+from rafiki_tpu.advisor.tpe import TpeAdvisor
 from rafiki_tpu.advisor.service import AdvisorService
 
-__all__ = ["BaseAdvisor", "RandomAdvisor", "GpAdvisor", "AdvisorService", "make_advisor"]
+__all__ = ["BaseAdvisor", "RandomAdvisor", "GpAdvisor", "TpeAdvisor",
+           "AdvisorService", "make_advisor"]

@@ -106,12 +106,20 @@ class BaseAdvisor:
     SURVEY.md §7 "advisor fidelity").
     """
 
+    #: constant-liar list cap: a worker that dies before feedback()
+    #: must not suppress a region forever (oldest liars expire first).
+    PENDING_CAP = 16
+
     def __init__(self, knob_config: KnobConfig, seed: int = 0):
         self.space = KnobSpace(knob_config)
         self.knob_config = dict(knob_config)
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
         self.history: List[Tuple[Knobs, float]] = []
+        # Proposed-but-unscored points (constant liars). Engines add
+        # via _pending_add and read via _pending / _pending_dists; the
+        # drain on feedback happens here so no engine can forget it.
+        self._pending: List[np.ndarray] = []
 
     def propose(self) -> Knobs:
         with self._lock:
@@ -120,7 +128,26 @@ class BaseAdvisor:
     def feedback(self, score: float, knobs: Knobs) -> None:
         with self._lock:
             self.history.append((dict(knobs), float(score)))
+            if self._pending and self.space.d:
+                x = self.space.encode(knobs)
+                self._pending = [p for p in self._pending
+                                 if not np.allclose(p, x, atol=1e-9)]
             self._feedback(float(score), dict(knobs))
+
+    # -- constant-liar helpers (called under the lock) ----------------------
+
+    def _pending_add(self, x: np.ndarray) -> None:
+        """Record a proposal awaiting its score; capped on EVERY append
+        (an uncapped path would grow forever under lost feedbacks)."""
+        self._pending.append(x)
+        while len(self._pending) > self.PENDING_CAP:
+            self._pending.pop(0)
+
+    def _pending_dists(self, cand: np.ndarray, span: np.ndarray):
+        """Span-normalized distance array (n_cand,) per pending point —
+        engines turn these into their own damping."""
+        for p in self._pending:
+            yield np.linalg.norm((cand - p) / span, axis=1)
 
     def best(self) -> Optional[Tuple[Knobs, float]]:
         with self._lock:
@@ -137,13 +164,16 @@ class BaseAdvisor:
 
 
 def make_advisor(knob_config: KnobConfig, kind: str = "gp", seed: int = 0) -> BaseAdvisor:
-    """Factory: 'gp' (default, reference's BTB-GP/skopt analog),
-    'random', or 'grid-free' aliases."""
+    """Factory: 'gp' (default, reference's BTB-GP/skopt analog), 'tpe'
+    (Parzen-estimator engine — cheap past hundreds of observations),
+    or 'random'."""
     from rafiki_tpu.advisor.gp import GpAdvisor
     from rafiki_tpu.advisor.random_advisor import RandomAdvisor
+    from rafiki_tpu.advisor.tpe import TpeAdvisor
 
     kinds = {"gp": GpAdvisor, "bayesian": GpAdvisor, "btb_gp": GpAdvisor,
-             "skopt": GpAdvisor, "random": RandomAdvisor}
+             "skopt": GpAdvisor, "random": RandomAdvisor,
+             "tpe": TpeAdvisor, "hyperopt": TpeAdvisor}
     if kind not in kinds:
         raise ValueError(f"Unknown advisor kind {kind!r}; choose from {sorted(kinds)}")
     return kinds[kind](knob_config, seed=seed)

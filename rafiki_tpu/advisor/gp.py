@@ -34,14 +34,13 @@ class GpAdvisor(BaseAdvisor):
         self._X: List[np.ndarray] = []
         self._y: List[float] = []
         self._gp = None
-        self._pending: List[np.ndarray] = []  # proposed, not yet scored
 
     def _propose(self) -> Knobs:
         if self.space.d == 0:
             return dict(self.space.fixed)
         if len(self._X) < self.n_initial or self._gp is None:
             knobs = self.space.sample(self._rng)
-            self._pending.append(self.space.encode(knobs))
+            self._pending_add(self.space.encode(knobs))
             return knobs
         b = self.space.bounds()
         cand = self._rng.uniform(b[:, 0], b[:, 1], size=(self.n_candidates, self.space.d))
@@ -52,27 +51,23 @@ class GpAdvisor(BaseAdvisor):
         cand = np.clip(np.vstack([cand, local]), b[:, 0], b[:, 1])
         ei = self._expected_improvement(cand)
         # Penalise candidates near pending (liar) points so concurrent
-        # workers don't all get the same proposal.
-        for p in self._pending:
-            dist = np.linalg.norm((cand - p) / np.maximum(b[:, 1] - b[:, 0], 1e-12), axis=1)
+        # workers don't all get the same proposal (bookkeeping lives in
+        # BaseAdvisor; only the damping shape is engine-specific).
+        span = np.maximum(b[:, 1] - b[:, 0], 1e-12)
+        for dist in self._pending_dists(cand, span):
             ei = ei * (1.0 - np.exp(-(dist / 0.05) ** 2))
         x = cand[int(np.argmax(ei))]
         knobs = self.space.decode(x)
         # Store the *re-encoded* point: decode rounds integer/categorical
-        # dims, and feedback() removes by encode(knobs) — appending raw x
-        # would leave the pending point stuck forever. Cap the list so a
-        # worker that dies before feedback() can't suppress a region
-        # forever (oldest liars expire first).
-        self._pending.append(self.space.encode(knobs))
-        if len(self._pending) > 16:
-            self._pending.pop(0)
+        # dims, and the feedback drain removes by encode(knobs) —
+        # appending raw x would leave the pending point stuck forever.
+        self._pending_add(self.space.encode(knobs))
         return knobs
 
     def _feedback(self, score: float, knobs: Knobs) -> None:
         x = self.space.encode(knobs)
         self._X.append(x)
         self._y.append(score)
-        self._pending = [p for p in self._pending if not np.allclose(p, x, atol=1e-9)]
         if len(self._X) >= max(2, min(self.n_initial, 4)):
             self._fit()
 

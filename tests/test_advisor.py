@@ -117,6 +117,40 @@ def test_fixed_only_space():
     assert adv.propose() == {"k": 1}
 
 
+def test_tpe_advisor_proposals_valid():
+    from rafiki_tpu.advisor import TpeAdvisor
+    from rafiki_tpu.model.knobs import validate_knobs
+
+    adv = TpeAdvisor(_config(), seed=0, n_initial=4)
+    for i in range(30):
+        knobs = adv.propose()
+        validate_knobs(_config(), knobs)
+        assert knobs["fixed"] == 42
+        adv.feedback(_objective(knobs), knobs)
+    assert len(adv._pending) == 0
+
+
+def test_tpe_advisor_beats_random():
+    """TPE must also strictly beat random with the same budget — it is
+    the second real engine, not a random fallback. Calibrated over 8
+    seeds at budget 80: TPE mean ~0.05, random mean ~-0.27; the 0.15
+    margin sits inside the gap with room for seed noise."""
+    from rafiki_tpu.advisor import TpeAdvisor
+
+    budget = 80
+    results = {}
+    for kind in ("tpe", "random"):
+        bests = []
+        for seed in range(8):
+            adv = make_advisor(_hard_config(), kind=kind, seed=seed)
+            for _ in range(budget):
+                knobs = adv.propose()
+                adv.feedback(_hard_objective(knobs), knobs)
+            bests.append(adv.best()[1])
+        results[kind] = float(np.mean(bests))
+    assert results["tpe"] >= results["random"] + 0.15, results
+
+
 def test_gp_advisor_concurrent_ask_tell():
     """k worker threads share ONE GpAdvisor (the scheduler's shape —
     SURVEY.md §7 'serialize ask/tell behind a lock'): no crash in _fit,
