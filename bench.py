@@ -131,7 +131,10 @@ def _init_backend() -> str:
     """Retry-with-backoff backend init; returns the platform string."""
     if os.environ.get("RAFIKI_BENCH_SELFTEST_FAIL"):
         raise RuntimeError("selftest: forced backend failure")
-    if os.environ.get("RAFIKI_BENCH_PLATFORM", "").lower() == "cpu":
+    if (os.environ.get("RAFIKI_BENCH_PLATFORM", "").lower() == "cpu"
+            or os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"):
+        # Honor a CPU request (either spelling) instead of probing the
+        # possibly-dead TPU backend the sitecustomize hijack registers.
         from rafiki_tpu.utils.backend import force_cpu_backend
 
         force_cpu_backend()

@@ -43,6 +43,12 @@ def main() -> int:
         from rafiki_tpu.admin import Admin
         from rafiki_tpu.admin.app import AdminApp
         from rafiki_tpu.config import Config, set_config
+        from rafiki_tpu.utils.backend import honor_env_platform
+
+        # JAX_PLATFORMS=cpu must actually stick (the image's
+        # sitecustomize would otherwise hijack onto the TPU plugin and
+        # hang the scheduler thread when the TPU is unreachable).
+        honor_env_platform()
 
         cfg = Config(data_dir=Path(tempfile.mkdtemp(prefix="rafiki_quickstart_")))
         cfg.ensure_dirs()

@@ -343,6 +343,9 @@ def serve(host: Optional[str] = None, port: Optional[int] = None,
     """Blocking server entry point (scripts/start_admin.py uses this)."""
     from werkzeug.serving import make_server
 
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()  # JAX_PLATFORMS=cpu must survive sitecustomize
     admin = admin or Admin()
     app = AdminApp(admin)
     host = host or admin.config.admin_host

@@ -43,10 +43,9 @@ def main() -> int:
     # sitecustomize force-registers a TPU backend otherwise).
     import jax
 
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        from rafiki_tpu.utils.backend import force_cpu_backend
+    from rafiki_tpu.utils.backend import honor_env_platform
 
-        force_cpu_backend()
+    honor_env_platform()
 
     # Backend-init watchdog: jax blocks indefinitely when the TPU
     # runtime is unreachable; a silent hang would stall the scheduler's
