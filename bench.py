@@ -131,13 +131,14 @@ def _init_backend() -> str:
     """Retry-with-backoff backend init; returns the platform string."""
     if os.environ.get("RAFIKI_BENCH_SELFTEST_FAIL"):
         raise RuntimeError("selftest: forced backend failure")
-    if (os.environ.get("RAFIKI_BENCH_PLATFORM", "").lower() == "cpu"
-            or os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"):
-        # Honor a CPU request (either spelling) instead of probing the
-        # possibly-dead TPU backend the sitecustomize hijack registers.
-        from rafiki_tpu.utils.backend import force_cpu_backend
+    from rafiki_tpu.utils.backend import force_cpu_backend, honor_env_platform
 
+    if os.environ.get("RAFIKI_BENCH_PLATFORM", "").lower() == "cpu":
         force_cpu_backend()
+        import jax
+
+        return jax.devices()[0].platform
+    if honor_env_platform():  # JAX_PLATFORMS=cpu: skip the TPU probe
         import jax
 
         return jax.devices()[0].platform

@@ -266,11 +266,23 @@ class JaxModel(BaseModel):
 
     # -- contract hooks ------------------------------------------------------
 
+    def _prepared_dataset(self, dataset_uri: str) -> Dataset:
+        """Load + preprocess. When preprocess is the identity (returns
+        the same array — the default), the process-cached Dataset
+        object is used AS-IS so the device-resident copy attached to it
+        (ops.train.get_device_dataset) is shared across trials; a
+        custom preprocess gets a fresh wrapper per call (its output may
+        depend on per-trial knobs, so it cannot be shared safely)."""
+        ds = dataset_utils.load(dataset_uri)
+        x = self.preprocess(ds.x)
+        if x is ds.x:
+            return ds
+        return Dataset(x, ds.y, ds.classes, ds.mask, ds.meta)
+
     def train(self, dataset_uri: str) -> None:
         from rafiki_tpu.model.log import logger
 
-        ds = dataset_utils.load(dataset_uri)
-        ds = Dataset(self.preprocess(ds.x), ds.y, ds.classes, ds.mask, ds.meta)
+        ds = self._prepared_dataset(dataset_uri)
         self._dataset_meta = dict(ds.meta)
         num_classes, input_shape = self._dataset_arch(ds)
         self._planned_steps = self.epochs * max(1, ds.size // self.batch_size)
@@ -293,8 +305,7 @@ class JaxModel(BaseModel):
     def evaluate(self, dataset_uri: str) -> float:
         if self._loop is None:
             raise RuntimeError("Model has no parameters: call train() or load_parameters() first")
-        ds = dataset_utils.load(dataset_uri)
-        ds = Dataset(self.preprocess(ds.x), ds.y, ds.classes, ds.mask, ds.meta)
+        ds = self._prepared_dataset(dataset_uri)
         return float(self._loop.evaluate(ds, self.batch_size))
 
     def predict(self, queries: List[Any]) -> List[List[float]]:

@@ -76,6 +76,7 @@ class Dataset:
         shuffle: bool = False,
         seed: int = 0,
         drop_remainder: bool = True,
+        start: int = 0,
     ) -> Iterator[dict]:
         """Yield dicts of fixed-shape numpy batches.
 
@@ -84,10 +85,12 @@ class Dataset:
         drop_remainder=False → eval mode: the last batch is zero-padded
             to batch_size and carries ``valid`` (bool mask over rows) so
             metrics can ignore padding.
+        start → skip the first ``start`` rows (in iteration order); used
+            when a device-side scan already covered a prefix.
         """
         n = self.size
         order = np.random.default_rng(seed).permutation(n) if shuffle else np.arange(n)
-        for start in range(0, n, batch_size):
+        for start in range(start, n, batch_size):
             idx = order[start : start + batch_size]
             if len(idx) < batch_size:
                 if drop_remainder:

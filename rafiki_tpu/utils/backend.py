@@ -50,17 +50,21 @@ def force_cpu_backend(n_devices: int | None = None) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def honor_env_platform() -> None:
+def honor_env_platform() -> bool:
     """Apply a ``JAX_PLATFORMS=cpu`` request for real.
 
     This image's sitecustomize registers the axon TPU plugin regardless
     of the env var, so the env alone is silently ignored — and when the
     TPU tunnel is down, the first ``jax.devices()`` then hangs forever.
-    Entry points that respect the env (quickstart, serve, workers) call
-    this once before touching jax.
+    Entry points that respect the env (quickstart, serve, workers,
+    bench) call this once before touching jax. Returns True when a CPU
+    request was applied (callers can then skip TPU reachability
+    probes).
     """
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         force_cpu_backend()
+        return True
+    return False
 
 
 def enable_compilation_cache(cache_dir: str | os.PathLike | None = None,

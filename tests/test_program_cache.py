@@ -54,7 +54,8 @@ def test_second_same_sig_trial_reuses_program():
     m1 = _run_trial(FeedForward, _ff_knobs())
     prog1 = m1._loop.program
     before = program_cache_stats()
-    n_exec_before = prog1.train_step._cache_size()
+    # Trials run epochs through the device-resident scan program.
+    n_exec_before = prog1.train_epoch._cache_size()
 
     m2 = _run_trial(FeedForward, _ff_knobs(learning_rate=3e-2, epochs=2))
     after = program_cache_stats()
@@ -62,8 +63,8 @@ def test_second_same_sig_trial_reuses_program():
     assert m2._loop.program is prog1
     assert after["misses"] == before["misses"], "second trial compiled a new program"
     assert after["hits"] == before["hits"] + 1
-    # the jitted step served trial 2 from its existing executable
-    assert prog1.train_step._cache_size() == n_exec_before
+    # the jitted epoch served trial 2 from its existing executable
+    assert prog1.train_epoch._cache_size() == n_exec_before
     m1.destroy(), m2.destroy()
 
 
@@ -88,7 +89,7 @@ def test_vgg_dropout_and_lr_are_dynamic():
 
     assert m2._loop.program is prog1
     assert program_cache_stats()["misses"] == before["misses"]
-    assert prog1.train_step._cache_size() == 1
+    assert prog1.train_epoch._cache_size() == 1
     m1.destroy(), m2.destroy()
 
 
