@@ -379,11 +379,13 @@ def _default_device_key():
 
 
 def get_device_dataset(dataset) -> Tuple[jax.Array, jax.Array]:
-    """The dataset's (x, y) as device arrays, cached per target device."""
-    cache = getattr(dataset, "_device_arrays", None)
-    if cache is None:
-        cache = {}
-        dataset._device_arrays = cache
+    """The dataset's (x, y) as device arrays, cached per target device.
+
+    setdefault keeps concurrent first-touchers (worker threads on
+    different devices sharing one LRU-cached dataset) from replacing
+    each other's cache dict; a same-device double upload is a benign
+    last-writer-wins."""
+    cache = dataset.__dict__.setdefault("_device_arrays", {})
     key = _default_device_key()
     if key not in cache:
         cache[key] = (jnp.asarray(dataset.x), jnp.asarray(dataset.y))
