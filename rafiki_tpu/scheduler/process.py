@@ -79,6 +79,76 @@ def worker_device_env(platform: str, worker_index: int,
     }
 
 
+class _WorkerGroup:
+    """One worker slot's process set (leader + multihost followers)
+    plus its restart bookkeeping. procs[0] is always the leader."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.procs: List[subprocess.Popen] = []
+        self.out_files: list = []
+        self.service: Optional[dict] = None
+        self.leader_worker_id = ""
+        self.restarts = 0
+        self.respawn_at: Optional[float] = None  # monotonic; None = live
+        # Service rows of every dead predecessor in this slot: the
+        # replacement must sweep them ALL — a restart that crashed
+        # before adopting leaves the orphan bound to an older corpse.
+        self.dead_services: List[str] = []
+
+    def state(self) -> str:
+        """'running' | 'ok' | 'failed'. A member dead non-zero while the
+        leader hasn't exited cleanly fails the whole group immediately —
+        the survivors are inside (or headed into) collectives their dead
+        peer will never join, and waiting for the transport timeout to
+        tell us so would wedge the job for minutes."""
+        rcs = [p.poll() for p in self.procs]
+        if any(rc is None for rc in rcs):
+            if any(rc not in (0, None) for rc in rcs) and rcs[0] != 0:
+                return "failed"
+            return "running"
+        return "ok" if rcs[0] == 0 else "failed"
+
+    def terminate(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    def collect(self, blame=lambda k, rc: rc != 0) -> List[str]:
+        """Reap every process and read its output; returns descriptions
+        of members the ``blame(member_index, rc)`` predicate selects."""
+        msgs = []
+        for k, (p, f) in enumerate(zip(self.procs, self.out_files)):
+            try:
+                rc = p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rc = p.wait()
+            f.seek(0)
+            out = f.read()
+            f.close()
+            if blame(k, rc):
+                label = (f"worker {self.index}" if k == 0
+                         else f"worker {self.index} follower {k}")
+                msgs.append(f"{label} rc={rc}: {out[-2000:]}")
+        self.procs, self.out_files = [], []
+        return msgs
+
+    def shutdown(self) -> List[str]:
+        """Kill survivors, reap everything; returns the ORIGINAL
+        failures only — members this teardown killed are not blamed."""
+        original = [p.poll() for p in self.procs]
+        self.terminate()
+        return self.collect(blame=lambda k, rc: original[k] not in (None, 0))
+
+
 class ProcessScheduler:
     """Same run_train_job contract as LocalScheduler, subprocess workers."""
 
@@ -186,6 +256,73 @@ class ProcessScheduler:
             best_trials=self.store.get_best_trials_of_train_job(job_id, limit=2),
             duration_s=time.time() - t0, errors=errors)
 
+    def _spawn_group(self, g: _WorkerGroup, ctx: dict,
+                     port: Optional[int] = None) -> None:
+        """(Re)spawn one worker group: a fresh service row, a fresh
+        leader worker id (suffixed -r<attempt> on restarts), and — when
+        this is a restart — the adopt hook env pointing at the dead
+        predecessor's service row so the new leader resumes its
+        orphaned trial."""
+        import tempfile
+
+        job, sub = ctx["job"], ctx["sub"]
+        platform, mh = ctx["platform"], ctx["multihost"]
+        service = self.store.create_service(
+            ServiceType.TRAIN_WORKER.value, job_id=job["id"],
+            worker_index=g.index, devices=[f"{platform}:{g.index}"])
+        g.service = service
+        # Multi-host dp group: N processes per worker — process 0 leads
+        # (control plane), 1..N-1 follow (compute mirror,
+        # worker/follower.py) — coordinated via jax.distributed on a
+        # per-group loopback port (production pods use the pod's
+        # coordinator host; same env contract).
+        coordinator = f"127.0.0.1:{port}" if mh > 1 else None
+        leader_worker_id = f"{job['id'][:8]}-p{g.index}" + (
+            f"-r{g.restarts}" if g.restarts else "")
+        g.leader_worker_id = leader_worker_id
+        for j in range(mh):
+            env = dict(os.environ)
+            if not (platform == "tpu" and mh > 1):
+                env.update(worker_device_env(
+                    platform, g.index * mh + j, ctx["devices_per_trial"]))
+            # else: a real multi-host TPU group must keep the pod
+            # runtime's own topology env (TPU_WORKER_ID etc.) — a
+            # flat per-process chip index + single-process bounds
+            # would contradict the jax.distributed cluster.
+            env.update({
+                "RAFIKI_WORKER_DB": self.db_path,
+                "RAFIKI_WORKER_PARAMS_DIR": self.params_dir,
+                "RAFIKI_WORKER_SUB_JOB_ID": sub["id"],
+                "RAFIKI_WORKER_ID": leader_worker_id + (
+                    f".{j}" if mh > 1 and j > 0 else ""),
+                "RAFIKI_WORKER_SERVICE_ID": service["id"] if j == 0 else "",
+                "RAFIKI_WORKER_ADVISOR_URL": ctx["advisor_url"],
+                "RAFIKI_WORKER_ADVISOR_ID": ctx["advisor_id"],
+                "RAFIKI_WORKER_ADVISOR_SECRET": ctx["secret"],
+            })
+            if j == 0 and g.dead_services:
+                env["RAFIKI_WORKER_ADOPT_SERVICE_ID"] = ",".join(g.dead_services)
+            if coordinator is not None:
+                env.update({
+                    "RAFIKI_COORDINATOR_ADDRESS": coordinator,
+                    "RAFIKI_NUM_PROCESSES": str(mh),
+                    "RAFIKI_PROCESS_ID": str(j),
+                    "RAFIKI_LEADER_WORKER_ID": leader_worker_id,
+                    "RAFIKI_LEADER_SERVICE_ID": service["id"],
+                })
+            if events.path is not None:  # subprocess shares the event sink
+                env["RAFIKI_EVENTS_DIR"] = str(events.path.parent)
+            # Worker output goes to a temp file, not a pipe: a full
+            # pipe buffer would block the worker's writes and
+            # deadlock the supervise loop.
+            out_f = tempfile.TemporaryFile(mode="w+t")
+            g.out_files.append(out_f)
+            g.procs.append(subprocess.Popen(
+                [sys.executable, "-m", "rafiki_tpu.worker.main"],
+                env=env, stdout=out_f, stderr=subprocess.STDOUT, text=True))
+        self.store.update_service(service["id"],
+                                  status=ServiceStatus.RUNNING.value)
+
     def _run_sub_job(self, sub: dict, job: dict, n_workers: int,
                      devices_per_trial: int, advisor_kind: str, platform: str,
                      advisor_url: str, secret: str,
@@ -207,97 +344,113 @@ class ProcessScheduler:
         self.store.update_sub_train_job(sub["id"], advisor_id=advisor_id,
                                         status=TrainJobStatus.RUNNING.value)
 
-        import tempfile
-
-        procs: List[subprocess.Popen] = []
-        proc_services: List[Optional[dict]] = []  # leader's service row or None
-        out_files = []
+        ctx = dict(sub=sub, job=job, platform=platform,
+                   devices_per_trial=devices_per_trial,
+                   multihost=multihost_processes, advisor_url=advisor_url,
+                   advisor_id=advisor_id, secret=secret)
         ports = (_free_ports(n_workers) if multihost_processes > 1 else
                  [None] * n_workers)
+        groups = []
         for i in range(n_workers):
-            service = self.store.create_service(
-                ServiceType.TRAIN_WORKER.value, job_id=job["id"],
-                worker_index=i, devices=[f"{platform}:{i}"])
-            # Multi-host dp group: N processes per worker — process 0
-            # leads (control plane), 1..N-1 follow (compute mirror,
-            # worker/follower.py) — coordinated via jax.distributed on
-            # a per-group loopback port (production pods use the pod's
-            # coordinator host; same env contract).
-            coordinator = (f"127.0.0.1:{ports[i]}"
-                           if multihost_processes > 1 else None)
-            leader_worker_id = f"{job['id'][:8]}-p{i}"
-            for j in range(multihost_processes):
-                env = dict(os.environ)
-                if not (platform == "tpu" and multihost_processes > 1):
-                    env.update(worker_device_env(
-                        platform, i * multihost_processes + j, devices_per_trial))
-                # else: a real multi-host TPU group must keep the pod
-                # runtime's own topology env (TPU_WORKER_ID etc.) — a
-                # flat per-process chip index + single-process bounds
-                # would contradict the jax.distributed cluster.
-                env.update({
-                    "RAFIKI_WORKER_DB": self.db_path,
-                    "RAFIKI_WORKER_PARAMS_DIR": self.params_dir,
-                    "RAFIKI_WORKER_SUB_JOB_ID": sub["id"],
-                    "RAFIKI_WORKER_ID": leader_worker_id + (
-                        f".{j}" if multihost_processes > 1 and j > 0 else ""),
-                    "RAFIKI_WORKER_SERVICE_ID": service["id"] if j == 0 else "",
-                    "RAFIKI_WORKER_ADVISOR_URL": advisor_url,
-                    "RAFIKI_WORKER_ADVISOR_ID": advisor_id,
-                    "RAFIKI_WORKER_ADVISOR_SECRET": secret,
-                })
-                if coordinator is not None:
-                    env.update({
-                        "RAFIKI_COORDINATOR_ADDRESS": coordinator,
-                        "RAFIKI_NUM_PROCESSES": str(multihost_processes),
-                        "RAFIKI_PROCESS_ID": str(j),
-                        "RAFIKI_LEADER_WORKER_ID": leader_worker_id,
-                        "RAFIKI_LEADER_SERVICE_ID": service["id"],
-                    })
-                if events.path is not None:  # subprocess shares the event sink
-                    env["RAFIKI_EVENTS_DIR"] = str(events.path.parent)
-                # Worker output goes to a temp file, not a pipe: a full
-                # pipe buffer would block the worker's writes and
-                # deadlock the supervise loop below.
-                out_f = tempfile.TemporaryFile(mode="w+t")
-                out_files.append(out_f)
-                proc = subprocess.Popen(
-                    [sys.executable, "-m", "rafiki_tpu.worker.main"],
-                    env=env, stdout=out_f, stderr=subprocess.STDOUT, text=True)
-                procs.append(proc)
-                proc_services.append(service if j == 0 else None)
-            self.store.update_service(service["id"],
-                                      status=ServiceStatus.RUNNING.value)
+            g = _WorkerGroup(i)
+            self._spawn_group(g, ctx, port=ports[i])
+            groups.append(g)
 
-        # Supervise: wait for exits; on stop_event, terminate.
-        while any(p.poll() is None for p in procs):
+        # Supervise with in-job elasticity (SURVEY.md §5: the analog of
+        # the reference's Swarm restart policy, which resurrected
+        # crashed worker containers). A group any member of which dies
+        # non-zero is torn down AT ONCE — survivors are killed rather
+        # than left to stall until the collective transport timeout —
+        # and respawned with exponential backoff, up to max_restarts
+        # per group; the replacement leader CAS-adopts the dead
+        # worker's orphaned RUNNING trial (worker/main.py adopt hook),
+        # so the job still completes its full trial budget.
+        max_restarts = int(os.environ.get("RAFIKI_WORKER_MAX_RESTARTS", "2"))
+        backoff0 = float(os.environ.get("RAFIKI_WORKER_RESTART_BACKOFF_S", "0.5"))
+        abandoned_services: set = set()  # corpses with no replacement coming
+        while groups:
             if stop_event.is_set():
-                for p in procs:
-                    if p.poll() is None:
-                        p.terminate()
-                for p in procs:
-                    try:
-                        p.wait(timeout=10)
-                    except subprocess.TimeoutExpired:
-                        p.kill()
+                for g in groups:
+                    g.terminate()
+                for g in groups:
+                    g.collect(blame=lambda k, rc: False)
+                    if g.respawn_at is None:
+                        # Live group: its service row goes STOPPED. A
+                        # group caught in its backoff window keeps the
+                        # ERRORED corpse row, and its orphaned trial is
+                        # terminated below — no replacement is coming,
+                        # and leaving it RUNNING would hand a trial of
+                        # an explicitly-stopped job to the periodic
+                        # recovery sweep.
+                        self.store.update_service(
+                            g.service["id"],
+                            status=ServiceStatus.STOPPED.value)
+                    for t in self.store.get_trials_of_sub_train_job(sub["id"]):
+                        if (t["status"] == TrialStatus.RUNNING.value
+                                and t.get("service_id") in (
+                                    {g.service["id"]} | set(g.dead_services))):
+                            self.store.mark_trial_as_terminated(t["id"])
+                groups.clear()
                 break
-            time.sleep(poll_s)
-
-        for k, (p, svc, out_f) in enumerate(zip(procs, proc_services, out_files)):
-            rc = p.wait()
-            out_f.seek(0)
-            out = out_f.read()
-            out_f.close()
-            if rc != 0 and not stop_event.is_set():
-                label = (f"worker {svc['worker_index']}" if svc is not None
-                         else f"follower proc {k}")
-                sub_errors.append(f"{label} rc={rc}: {out[-2000:]}")
-                if svc is not None:
-                    self.store.update_service(svc["id"],
-                                              status=ServiceStatus.ERRORED.value)
-            elif svc is not None:
-                self.store.update_service(svc["id"],
-                                          status=ServiceStatus.STOPPED.value)
+            now = time.monotonic()
+            for g in list(groups):
+                if g.respawn_at is not None:  # waiting out its backoff
+                    if now < g.respawn_at:
+                        continue
+                    g.respawn_at = None
+                    port = (_free_ports(1)[0]
+                            if multihost_processes > 1 else None)
+                    self._spawn_group(g, ctx, port=port)
+                    events.emit("worker_restarted", job_id=job["id"],
+                                worker_index=g.index, attempt=g.restarts,
+                                adopt_service_ids=list(g.dead_services))
+                    continue
+                state = g.state()
+                if state == "running":
+                    continue
+                if state == "ok":
+                    # Non-zero follower exits AFTER a clean leader exit
+                    # (budget drained) are shutdown noise, not job
+                    # failures — recorded as events only.
+                    for msg in g.collect():
+                        events.emit("worker_exit_noise", job_id=job["id"],
+                                    worker_index=g.index, detail=msg[:500])
+                    self.store.update_service(
+                        g.service["id"], status=ServiceStatus.STOPPED.value)
+                    groups.remove(g)
+                    continue
+                # state == "failed": tear down, then restart or give up.
+                failures = g.shutdown()
+                self.store.update_service(
+                    g.service["id"], status=ServiceStatus.ERRORED.value)
+                if g.restarts < max_restarts:
+                    g.restarts += 1
+                    g.dead_services.append(g.service["id"])
+                    g.respawn_at = now + backoff0 * (2 ** (g.restarts - 1))
+                    events.emit("worker_died", job_id=job["id"],
+                                worker_index=g.index,
+                                restart_attempt=g.restarts,
+                                max_restarts=max_restarts,
+                                detail=(failures[0][:500] if failures else ""))
+                else:
+                    sub_errors.extend(failures)
+                    events.emit("worker_failed_permanently", job_id=job["id"],
+                                worker_index=g.index, restarts=g.restarts)
+                    abandoned_services.update(g.dead_services)
+                    abandoned_services.add(g.service["id"])
+                    groups.remove(g)
+            if groups:
+                time.sleep(poll_s)
+        if abandoned_services:
+            # No replacement is coming for these corpses: their orphaned
+            # RUNNING trials would otherwise hang the sub-job status in
+            # limbo (and a later recovery sweep would re-run a trial
+            # whose worker slot provably cannot stay alive).
+            for t in self.store.get_trials_of_sub_train_job(sub["id"]):
+                if (t["status"] == TrialStatus.RUNNING.value
+                        and t.get("service_id") in abandoned_services):
+                    self.store.mark_trial_as_errored(
+                        t["id"], "worker died; restarts exhausted")
         errors.extend(sub_errors)
 
         trials = self.store.get_trials_of_sub_train_job(sub["id"])

@@ -288,25 +288,44 @@ class MetaStore:
             if advisor_id is not None:
                 c.execute("UPDATE sub_train_jobs SET advisor_id=? WHERE id=?", (advisor_id, sub_id))
 
+    @staticmethod
+    def _claim_slot(c: sqlite3.Connection, sub_id: str, max_trials: int) -> bool:
+        """Claim one of ``max_trials`` trial slots inside the caller's
+        open transaction; False = budget exhausted. The single source of
+        the budget-gate SQL for both claim forms below."""
+        cur = c.execute(
+            "UPDATE sub_train_jobs SET claimed = claimed + 1"
+            " WHERE id=? AND claimed < ?", (sub_id, int(max_trials)))
+        return cur.rowcount > 0
+
     def claim_trial_slot(self, sub_id: str, max_trials: int) -> bool:
-        """Atomically claim one of ``max_trials`` slots; False = budget
-        exhausted. This is the concurrency gate that stops N workers
-        racing past a trial-count budget (the reference leaned on
-        Postgres transactions for the same invariant)."""
+        """Standalone atomic slot claim — the concurrency gate that
+        stops N workers racing past a trial-count budget (the reference
+        leaned on Postgres transactions for the same invariant).
+        Production workers use ``create_trial(budget_max=...)`` instead,
+        which claims in the same transaction as the row insert; this
+        form remains for callers that size work before creating rows."""
         with self._conn() as c:
-            cur = c.execute(
-                "UPDATE sub_train_jobs SET claimed = claimed + 1"
-                " WHERE id=? AND claimed < ?", (sub_id, int(max_trials)))
-            return cur.rowcount > 0
+            return self._claim_slot(c, sub_id, max_trials)
 
     # -- trials --------------------------------------------------------------
 
     def create_trial(self, sub_train_job_id: str, model_name: str,
                      knobs: Dict[str, Any], worker_id: Optional[str] = None,
                      shape_sig: Optional[str] = None,
-                     service_id: Optional[str] = None) -> dict:
+                     service_id: Optional[str] = None,
+                     budget_max: Optional[int] = None) -> Optional[dict]:
+        """Insert a RUNNING trial row; with ``budget_max``, a trial-count
+        slot is claimed in the SAME write transaction (claimed++ guarded
+        by claimed < budget_max) and None is returned when the budget is
+        exhausted. The combined form exists for crash safety: a worker
+        killed between a separate ``claim_trial_slot`` and the insert
+        would leak the slot and silently shrink the job's budget."""
         tid = _uid()
         with self._conn() as c:
+            if budget_max is not None and not self._claim_slot(
+                    c, sub_train_job_id, budget_max):
+                return None
             # 'no' is assigned inside the INSERT's write transaction so
             # concurrent workers can't get duplicate trial numbers.
             c.execute(

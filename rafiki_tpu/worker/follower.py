@@ -19,11 +19,15 @@ mirrors each of the leader's trials compute-for-compute:
   * it exits when the sub-job reaches a terminal status or the trial
     budget is exhausted and nothing is running.
 
-Caveat (documented limitation): if the leader aborts a trial mid-epoch
-(worker crash, OOM), the follower is left inside a collective that the
-leader abandoned; the collective's transport timeout (gloo/DCN) then
-surfaces the failure here too, and the scheduler's group supervision
-restarts the whole group. Trial-level containment of *model* errors
+Group-failure handling: if any group member dies mid-trial (worker
+crash, OOM, SIGKILL), the scheduler's supervise loop detects the dead
+process directly and tears the WHOLE group down at once — survivors
+stuck inside a collective the dead peer abandoned are killed rather
+than left to wait out the transport timeout — then respawns the group
+(bounded restarts, exponential backoff); the new leader CAS-adopts the
+orphaned trial and the followers mirror its re-run from epoch 0
+(scheduler/process.py supervise loop, worker/train.py
+adopt_orphans_of_service). Trial-level containment of *model* errors
 still works: the leader catches them between collective programs.
 """
 

@@ -125,6 +125,16 @@ def main() -> int:
         worker_id=worker_id, devices=jax.devices())
     worker.service_id = service_id
     try:
+        # Restart path: this process replaces a crashed predecessor —
+        # sweep every dead service row the scheduler recorded for this
+        # slot and resume the orphaned trials bound to them (CAS-adopted
+        # exactly once even against a racing recovery sweep).
+        adopt_sids = os.environ.get("RAFIKI_WORKER_ADOPT_SERVICE_ID", "")
+        for sid in filter(None, adopt_sids.split(",")):
+            n_adopted = worker.adopt_orphans_of_service(sid)
+            if n_adopted:
+                print(f"worker {worker_id}: adopted {n_adopted} orphaned "
+                      f"trial(s) of dead service {sid}", flush=True)
         n = worker.run()
     finally:
         if coordinator and service_id:

@@ -116,7 +116,8 @@ class TrainWorker:
     # -- one trial -----------------------------------------------------------
 
     def run_trial(self, knobs: Knobs,
-                  resume_trial_id: Optional[str] = None) -> dict:
+                  resume_trial_id: Optional[str] = None,
+                  budget_max: Optional[int] = None) -> Optional[dict]:
         knob_config = self.model_class.get_knob_config()
         sig = knob_config_signature(knob_config, knobs)
         resume = resume_trial_id is not None
@@ -130,10 +131,14 @@ class TrainWorker:
                                              service_id=self.service_id,
                                              worker_id=self.worker_id)
         else:
+            # budget_max makes row-insert + slot-claim one transaction:
+            # None back = the budget drained under us, nothing to run.
             trial = self.store.create_trial(
                 self.sub_id, self.model_class.__name__, knobs,
                 worker_id=self.worker_id, shape_sig=sig,
-                service_id=self.service_id)
+                service_id=self.service_id, budget_max=budget_max)
+            if trial is None:
+                return None
         tid = trial["id"]
 
         def sink(entry):
@@ -197,7 +202,15 @@ class TrainWorker:
     def _wire_checkpoints(self, model: BaseModel, tid: str, resume: bool) -> None:
         """Attach mid-trial checkpointing (and restore on resume) when
         the model supports it and a cadence is configured."""
-        if resume and hasattr(model, "restore_checkpoint"):
+        import os as _os
+
+        multihost = int(_os.environ.get("RAFIKI_NUM_PROCESSES", "1")) > 1
+        if resume and hasattr(model, "restore_checkpoint") and not multihost:
+            # Multihost groups must NOT restore: followers mirror an
+            # adopted trial from epoch 0 (worker/follower.py has no
+            # checkpoint channel), so a leader resuming mid-stream would
+            # issue fewer collective programs than its followers replay
+            # — SPMD pairing beats saved progress.
             latest = self.params_store.latest_checkpoint(tid)
             if latest is not None:
                 epoch, blob = latest
@@ -281,16 +294,44 @@ class TrainWorker:
 
     # -- the loop ------------------------------------------------------------
 
+    def adopt_orphans_of_service(self, prev_service_id: str) -> int:
+        """Resume RUNNING trials stranded by a dead predecessor worker.
+
+        The in-job half of elastic recovery: when the scheduler restarts
+        a crashed worker (scheduler/process.py supervise loop), the
+        replacement CAS-adopts each trial still bound to the dead
+        worker's service row — a racing periodic recovery sweep then
+        loses the CAS, so every orphan is re-run exactly once — and
+        re-runs it (from its newest mid-trial checkpoint when one
+        exists). The predecessor already claimed these trials' budget
+        slots, so the job still completes its exact trial count.
+        """
+        n = 0
+        for t in self.store.get_trials_of_sub_train_job(self.sub_id):
+            if (t["status"] != TrialStatus.RUNNING.value
+                    or t.get("service_id") != prev_service_id):
+                continue
+            if not self.store.adopt_trial(t["id"], prev_service_id,
+                                          self.service_id, self.worker_id):
+                continue  # recovery sweep won the race; its re-run owns it
+            self.resume_trial(t["id"])
+            self.trials_run += 1
+            n += 1
+        return n
+
     def run(self) -> int:
         """Pull trials until the budget is exhausted. Returns #trials run."""
         max_trials = self.budget.get(BudgetType.MODEL_TRIAL_COUNT.value)
+        budget_max = int(max_trials) if max_trials is not None else None
         try:
             while not self.budget_exhausted():
-                if max_trials is not None and not self.store.claim_trial_slot(
-                        self.sub_id, int(max_trials)):
-                    break
                 knobs = self.advisor.propose()
-                self.run_trial(knobs)
+                # Slot-claim happens atomically inside the trial-row
+                # insert (crash between claim and insert cannot leak a
+                # budget slot); None back = budget drained, the unused
+                # proposal is simply dropped.
+                if self.run_trial(knobs, budget_max=budget_max) is None:
+                    break
                 self.trials_run += 1
                 if self.service_id is not None:
                     self.store.update_service(self.service_id, heartbeat=True)
