@@ -20,15 +20,29 @@ Canonical workload (mirrors BASELINE.md acceptance configs 2-3): VGG16
 width 1.0 on CIFAR-shaped synthetic data (50k train / 10k eval,
 32x32x3, 10 classes), one epoch per trial; the GP sweeps lr, dropout
 and batch size — the compile-relevant axis (batch) exercises the
-program cache across its 3 shape buckets. The synthetic task's
-attainable top-1 is ~1.0 (class templates + sigma=0.35 noise);
-``best_top1`` below 0.95 indicates a learning regression, satisfying
-the north star's "matched final top-1" clause for the synthetic proxy.
+program cache across its 3 shape buckets.
+
+The task is calibrated to be NON-saturating so the accuracy clause is
+falsifiable (scripts/calibrate_bench_task.py): 20% of labels are
+flipped uniformly, capping a perfect classifier at (1-0.2)+0.2/10 =
+0.82 top-1 regardless of scale, and pixel noise sigma=0.35 makes
+1-epoch accuracy measurably lr/dropout-sensitive (smoke-scale
+calibration 2026-07-30: good configs 0.71-0.77, bad configs at ~0.08
+chance, spread ~0.7). ``best_top1 < top1_target`` flips the bench to
+an error exit — a learning regression or an advisor steering into bad
+regions turns the bench red instead of shaving the headline silently.
+The canonical-scale target (0.70) is provisional pending a TPU
+calibration run (`scripts/calibrate_bench_task.py --canonical`).
 
 Also reported (detail): steady-state trials/hour over the warm tail,
-per-step training throughput and MFU vs the v5e's 197 TFLOP/s bf16
-peak, advisor cost measured POST-GP-fit (>=30 observations), params
-dump time, and program/compile-cache statistics.
+cold (first-completed) and slowest trial durations, per-step training
+throughput and MFU vs the v5e's 197 TFLOP/s bf16 peak (MFU basis: XLA
+whole-program flops — overstates vs the conventional model-flops MFU),
+advisor cost measured POST-GP-fit (>=30 observations), a GP-vs-random
+``advisor_lift`` from tiny-but-real trials, params dump time,
+program/compile-cache statistics, and acceptance config 5 served BOTH
+ways: the reference-shaped one-worker-per-trial ensemble and
+ServicesManager's stacked top-k path (one vmapped XLA program).
 
 vs_baseline: the 120 trials/hour/GPU denominator is an ESTIMATE
 (BASELINE.md §Baseline derivation: V100 mixed-precision VGG16
@@ -209,15 +223,22 @@ class BenchVgg(Vgg):
 
 
 def _scale(platform: str) -> dict:
+    # noise/flip and the per-scale top1 targets come from
+    # scripts/calibrate_bench_task.py (see module docstring): flip=0.2
+    # puts the accuracy ceiling at 0.82; targets sit below the measured
+    # good-config scores and well above the ~0.1 chance floor.
+    common = dict(noise=0.35, flip=0.2, lift_trials=12, lift_warmup=4)
     if platform == "cpu":  # smoke run for tests: seconds, not minutes
-        return dict(src=BENCH_MODEL_SRC_SMOKE, train_n=512, eval_n=128,
+        return dict(src=BENCH_MODEL_SRC_SMOKE, train_n=2048, eval_n=512,
                     w=8, trials=int(os.environ.get("RAFIKI_BENCH_TRIALS", "3")),
-                    micro_steps=5, canon_train=512, canon_eval=128,
-                    micro=dict(depth=11, width=0.25, batch=64))
+                    micro_steps=5, canon_train=2048, canon_eval=512,
+                    micro=dict(depth=11, width=0.25, batch=64),
+                    top1_target=0.30, **common)
     return dict(src=BENCH_MODEL_SRC, train_n=CANON_TRAIN, eval_n=CANON_EVAL,
                 w=32, trials=int(os.environ.get("RAFIKI_BENCH_TRIALS", "30")),
                 micro_steps=100, canon_train=CANON_TRAIN, canon_eval=CANON_EVAL,
-                micro=dict(depth=16, width=1.0, batch=128))
+                micro=dict(depth=16, width=1.0, batch=128),
+                top1_target=0.70, **common)
 
 
 # -- the real AutoML loop (headline) ----------------------------------------
@@ -229,9 +250,11 @@ def run_real_loop(sc: dict, detail: dict) -> None:
     from rafiki_tpu.ops.train import program_cache_stats
 
     train_uri = (f"synthetic://images?classes=10&n={sc['train_n']}"
-                 f"&w={sc['w']}&h={sc['w']}&c=3&seed=0")
+                 f"&w={sc['w']}&h={sc['w']}&c=3&seed=0"
+                 f"&noise={sc['noise']}&flip={sc['flip']}")
     val_uri = (f"synthetic://images?classes=10&n={sc['eval_n']}"
-               f"&w={sc['w']}&h={sc['w']}&c=3&seed=1")
+               f"&w={sc['w']}&h={sc['w']}&c=3&seed=1"
+               f"&noise={sc['noise']}&flip={sc['flip']}")
     import shutil
 
     tmp = tempfile.mkdtemp(prefix="rafiki-bench-")
@@ -252,34 +275,44 @@ def run_real_loop(sc: dict, detail: dict) -> None:
         wall = time.monotonic() - t0
         cache1 = program_cache_stats()
         if result.best_trials:
-            # Acceptance config 5 (BASELINE.md): serve the best trial
-            # behind the predictor/bus and measure query throughput.
+            # Acceptance config 5 (BASELINE.md): serve the top-k trials
+            # behind the predictor/bus and measure query throughput —
+            # both the per-trial-worker path and the stacked path.
             try:
-                _measure_serving(params, result, sc, detail)
+                _measure_serving(store, params, result, sc, detail)
             except Exception as e:  # serving metrics are additive, not fatal
                 detail["serving_error"] = f"{type(e).__name__}: {e}"
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
     done = [t for t in result.trials if t["status"] == "COMPLETED"]
-    per_trial = sorted(
-        (t["stopped_at"] - t["started_at"]) for t in done
-        if t.get("stopped_at") and t.get("started_at"))
+    # In completion order: the first trial to finish paid the cold
+    # compiles; later "slow" trials are stragglers, a different fact.
+    timed = sorted((t for t in done
+                    if t.get("stopped_at") and t.get("started_at")),
+                   key=lambda t: t["stopped_at"])
+    durations = [t["stopped_at"] - t["started_at"] for t in timed]
+    per_trial = sorted(durations)
     # Steady state = the warm tail: trials after every shape bucket has
     # compiled. Median of the fastest half is robust to stragglers.
     tail = per_trial[: max(1, len(per_trial) // 2)]
     steady_s = tail[len(tail) // 2] if tail else float("nan")
 
+    best_top1 = max((t["score"] for t in done), default=None)
     detail.update({
         "measured_trials": len(done),
         "errored_trials": len(result.trials) - len(done),
+        "n_workers": 1,
         "job_wall_s": round(wall, 2),
         "measured_trials_per_hour": round(3600.0 * len(done) / wall, 2),
-        "cold_trial_s": round(per_trial[-1], 2) if per_trial else None,
+        "cold_trial_s": round(durations[0], 2) if durations else None,
+        "slowest_trial_s": round(per_trial[-1], 2) if per_trial else None,
         "steady_trial_s": round(steady_s, 3),
         "steady_trials_per_hour": round(3600.0 / steady_s, 2) if steady_s > 0 else None,
-        "best_top1": max((t["score"] for t in done), default=None),
-        "top1_target": 0.95,
+        "best_top1": best_top1,
+        "top1_target": sc["top1_target"],
+        "top1_ceiling": round((1 - sc["flip"]) + sc["flip"] / 10, 3),
+        "top1_miss": best_top1 is None or best_top1 < sc["top1_target"],
         "programs_compiled": cache1["misses"] - cache0["misses"],
         "program_cache_hits": cache1["hits"] - cache0["hits"],
         "job_status": result.status,
@@ -290,9 +323,41 @@ def run_real_loop(sc: dict, detail: dict) -> None:
     _OUT["vs_baseline"] = round(_OUT["value"] / BASELINE_TRIALS_PER_HOUR_PER_GPU, 3)
 
 
-def _measure_serving(params, result, sc: dict, detail: dict) -> None:
-    """Queries/sec through the real serving path: predictor -> bus ->
-    inference worker -> jit'd batched forward of the best trial."""
+def _predict_ok(out) -> bool:
+    return not any(isinstance(o, dict) and "error" in o for o in out)
+
+
+def _measure_qps(pred, queries, rounds: int = 5,
+                 warm_deadline_s: float = 120) -> tuple:
+    """(qps, batch_latency_ms) through a live Predictor. Warm until the
+    predict program has actually compiled: the first forward can exceed
+    the predictor's timeout, which surfaces as {"error": ...} entries
+    rather than an exception — those must never count as served."""
+    deadline = time.monotonic() + warm_deadline_s
+    while not _predict_ok(pred.predict(queries[:8])):
+        if time.monotonic() > deadline:
+            raise RuntimeError("predict never warmed (timeouts only)")
+        time.sleep(1)
+    t0 = time.monotonic()
+    for _ in range(rounds):
+        out = pred.predict(queries)
+        if not _predict_ok(out):
+            raise RuntimeError("timeout/error response during timed rounds")
+    dt = time.monotonic() - t0
+    assert len(out) == len(queries)
+    return (round(rounds * len(queries) / dt, 1), round(1000.0 * dt / rounds, 1))
+
+
+def _measure_serving(store, params, result, sc: dict, detail: dict) -> None:
+    """Acceptance config 5 (BASELINE.md): predictor ensemble over the
+    top-k trained models. The REAL top-2 trials are served both ways
+    and both throughputs reported: (a) the reference-shaped fallback —
+    one InferenceWorker per trial, the predictor scatter/gathers and
+    mean-ensembles — and (b) through ServicesManager's stacked
+    selection (admin/services_manager.py), where same-architecture
+    trials fuse into ONE vmapped XLA program (parallel/serving.py).
+    ``serving_path`` records which path the services manager actually
+    engaged; ``serving_k`` the ensemble width."""
     import threading
 
     import numpy as np
@@ -302,49 +367,133 @@ def _measure_serving(params, result, sc: dict, detail: dict) -> None:
     from rafiki_tpu.predictor.predictor import Predictor
     from rafiki_tpu.worker.inference import InferenceWorker
 
-    best = result.best_trials[0]
+    best = result.best_trials[:2]
+    detail["serving_k"] = len(best)
     cls = load_model_class(sc["src"], "BenchVgg")
-    model = cls(**best["knobs"])
-    model.load_parameters(params.load(best["params_id"]))
+    rng = np.random.default_rng(0)
+    queries = list(rng.uniform(0, 1, size=(64, sc["w"], sc["w"], 3))
+                   .astype(np.float32))
+
+    # (a) one worker per trial: predictor fans out to k workers and
+    # ensembles — the reference's serving shape.
     bus = InProcBus()
-    worker = InferenceWorker(bus, "bench-inf", "iw-0", model)
-    th = threading.Thread(target=worker.run, daemon=True)
-    th.start()
+    models = []
+    for t in best:
+        m = cls(**t["knobs"])
+        m.load_parameters(params.load(t["params_id"]))
+        models.append(m)
+    workers = [InferenceWorker(bus, "bench-fb", f"iw-{i}", m)
+               for i, m in enumerate(models)]
+    threads = [threading.Thread(target=w.run, daemon=True) for w in workers]
+    for th in threads:
+        th.start()
     try:
         deadline = time.monotonic() + 60
-        while not bus.get_workers("bench-inf"):  # registration race
+        while len(bus.get_workers("bench-fb")) < len(workers):
             if time.monotonic() > deadline:
-                raise RuntimeError("inference worker never registered")
+                raise RuntimeError("inference workers never registered")
             time.sleep(0.05)
-        pred = Predictor(bus, "bench-inf")
-        rng = np.random.default_rng(0)
-        queries = list(rng.uniform(0, 1, size=(64, sc["w"], sc["w"], 3))
-                       .astype(np.float32))
-
-        def _ok(out):
-            return not any(isinstance(o, dict) and "error" in o for o in out)
-
-        # Warm until the predict program has actually compiled: the
-        # first forward can exceed the predictor's timeout, which
-        # surfaces as {"error": ...} entries rather than an exception —
-        # those must never be counted as served queries.
-        deadline = time.monotonic() + 120
-        while not _ok(pred.predict(queries[:8])):
-            if time.monotonic() > deadline:
-                raise RuntimeError("predict never warmed (timeouts only)")
-            time.sleep(1)
-        rounds = 5
-        t0 = time.monotonic()
-        for _ in range(rounds):
-            out = pred.predict(queries)
-            if not _ok(out):
-                raise RuntimeError("timeout/error response during timed rounds")
-        dt = time.monotonic() - t0
+        qps, lat = _measure_qps(Predictor(bus, "bench-fb"), queries)
+        detail["serving_qps_per_worker"] = qps
+        detail["serving_batch_latency_ms"] = lat
     finally:
-        worker.stop()
-    assert len(out) == len(queries)
-    detail["serving_qps"] = round(rounds * len(queries) / dt, 1)
-    detail["serving_batch_latency_ms"] = round(1000.0 * dt / rounds, 1)
+        for w in workers:
+            w.stop()
+        for th in threads:
+            th.join(timeout=10)
+        for m in models:
+            m.destroy()
+
+    if len(best) < 2:
+        detail["serving_path"] = "per-trial (k=1)"
+        return
+    # (b) the stacked path, through the real services manager: it
+    # re-loads the trial models itself and fuses them when stackable.
+    from rafiki_tpu.admin.services_manager import ServicesManager
+
+    inf = store.create_inference_job(result.job_id, None)
+    sm = ServicesManager(store, params)
+    pred = sm.create_inference_services(inf["id"], best, serve_http=False)
+    try:
+        handle = sm._inference_jobs[inf["id"]]
+        path = ("stacked" if len(handle.workers) < len(best)
+                else "per-trial-fallback")
+        detail["serving_path"] = path
+        qps, lat = _measure_qps(pred, queries)
+        if path == "stacked":
+            detail["serving_qps_stacked"] = qps
+            detail["serving_batch_latency_stacked_ms"] = lat
+        else:  # heterogeneous top-k: record it honestly, don't relabel
+            detail["serving_qps_fallback_via_services_manager"] = qps
+    finally:
+        sm.stop_inference_services(inf["id"])
+
+
+# -- advisor lift: GP vs random on tiny real trials --------------------------
+
+LIFT_MODEL_SRC = b'''
+from rafiki_tpu.model.knobs import FixedKnob, FloatKnob
+from rafiki_tpu.models.vgg import Vgg
+
+
+class LiftVgg(Vgg):
+    """Tiny real-training probe for GP-vs-random lift: one shape
+    bucket (fixed batch), wide log-lr axis where quality varies."""
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "depth": FixedKnob(11),
+            "width_mult": FixedKnob(0.25),
+            "dropout": FloatKnob(0.0, 0.5),
+            "learning_rate": FloatKnob(1e-4, 3e-2, is_exp=True),
+            "batch_size": FixedKnob(64),
+            "epochs": FixedKnob(1),
+            "seed": FixedKnob(0),
+        }
+'''
+
+
+def run_advisor_lift(sc: dict, detail: dict) -> None:
+    """GP-vs-random lift from tiny-but-real trials on the calibrated
+    task (the knob space is where 1-epoch top-1 demonstrably varies —
+    see scripts/calibrate_bench_task.py). Both advisors run the same
+    trial count with fixed seeds; ``advisor_lift`` = mean post-warmup
+    GP score minus the random advisor's mean over the same positions —
+    the exploitation the GP buys once it has observations. Kept tiny
+    (VGG11 w=0.25 on 8x8) so it costs seconds, not the headline's
+    minutes; the full-size advisor quality signal is the headline
+    job's gated best_top1."""
+    from rafiki_tpu.advisor.gp import GpAdvisor
+    from rafiki_tpu.advisor.random_advisor import RandomAdvisor
+    from rafiki_tpu.model.base import load_model_class
+
+    cls = load_model_class(LIFT_MODEL_SRC, "LiftVgg")
+    train = (f"synthetic://images?classes=10&n=2048&w=8&h=8&c=3&seed=0"
+             f"&noise={sc['noise']}&flip={sc['flip']}")
+    val = (f"synthetic://images?classes=10&n=512&w=8&h=8&c=3&seed=1"
+           f"&noise={sc['noise']}&flip={sc['flip']}")
+    n, warmup = sc["lift_trials"], sc["lift_warmup"]
+
+    def sweep(advisor) -> list:
+        scores = []
+        for _ in range(n):
+            knobs = advisor.propose()
+            m = cls(**knobs)
+            m.train(train)
+            s = float(m.evaluate(val))
+            m.destroy()
+            advisor.feedback(s, knobs)
+            scores.append(round(s, 4))
+        return scores
+
+    kc = cls.get_knob_config()
+    s_gp = sweep(GpAdvisor(kc, seed=0, n_initial=warmup))
+    s_rnd = sweep(RandomAdvisor(kc, seed=1))
+    mean = lambda xs: sum(xs) / len(xs)
+    detail["advisor_lift"] = round(mean(s_gp[warmup:]) - mean(s_rnd[warmup:]), 4)
+    detail["advisor_lift_best"] = round(max(s_gp) - max(s_rnd), 4)
+    detail["advisor_lift_trials"] = n
 
 
 # -- microbench: step throughput, MFU, advisor, dump ------------------------
@@ -416,6 +565,7 @@ def run_micro(sc: dict, detail: dict) -> None:
         "params_dump_s": round(dump_s, 3),
         "params_blob_mb": round(len(blob) / 1e6, 1),
         "mfu_vs_v5e_bf16_peak": round(mfu, 4) if mfu is not None else None,
+        "mfu_basis": "XLA whole-program flops — overstates vs model-flops MFU",
         "canonical_compute_s": round(
             sc["canon_train"] / train_img_s + sc["canon_eval"] / eval_img_s, 2),
     })
@@ -459,9 +609,22 @@ def main() -> None:
         if stall:
             time.sleep(stall)
         sc = _scale(platform)
+        if os.environ.get("RAFIKI_BENCH_TOP1_TARGET"):  # tests force the red path
+            sc["top1_target"] = float(os.environ["RAFIKI_BENCH_TOP1_TARGET"])
         detail["n_trials_requested"] = sc["trials"]
         run_real_loop(sc, detail)  # first: its compiles must be COLD
         run_micro(sc, detail)
+        run_advisor_lift(sc, detail)
+        if detail.get("top1_miss"):
+            # The accuracy clause is a GATE, not a footnote: a learning
+            # regression (or an advisor steering into bad regions) must
+            # turn the bench red, not quietly shave the headline.
+            _emit(error=(f"best_top1 {detail.get('best_top1')} below "
+                         f"target {sc['top1_target']} "
+                         f"(ceiling {detail.get('top1_ceiling')}) — "
+                         "learning regression"))
+            wd.cancel()
+            sys.exit(1)
         _emit()
     except BaseException as e:  # noqa: BLE001 — the JSON line must go out
         _emit(error=f"{type(e).__name__}: {e}")

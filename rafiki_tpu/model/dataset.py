@@ -112,7 +112,7 @@ class Dataset:
 # ---------------------------------------------------------------------------
 
 def synthetic_images(classes=10, w=28, h=28, c=1, n=2048, seed=0, noise=0.35,
-                     dist=0) -> Dataset:
+                     dist=0, flip=0.0) -> Dataset:
     """Class-conditional Gaussian-blob images.
 
     Each class k gets a fixed random template image; samples are
@@ -124,6 +124,13 @@ def synthetic_images(classes=10, w=28, h=28, c=1, n=2048, seed=0, noise=0.35,
     ``seed`` seeds the draws. Train/test splits of the same task share
     ``dist`` and differ in ``seed`` — otherwise they would be different
     classification problems and generalization would be impossible.
+
+    ``flip`` relabels that fraction of samples uniformly at random,
+    which caps attainable accuracy at a KNOWN ceiling independent of
+    model, scale, or epochs: a perfect template classifier scores
+    (1-flip) + flip/classes. That makes an accuracy target falsifiable
+    — on a saturating task (flip=0) every non-broken config converges
+    to ~1.0 and a "top-1 >= X" gate constrains nothing.
     """
     # Low-spatial-frequency templates (drawn coarse, then upsampled):
     # learnable both by flatten-head models (MLP/VGG) and by
@@ -138,6 +145,9 @@ def synthetic_images(classes=10, w=28, h=28, c=1, n=2048, seed=0, noise=0.35,
     y = rng.integers(0, classes, size=n).astype(np.int32)
     x = templates[y] + rng.normal(0.0, noise, size=(n, h, w, c)).astype(np.float32)
     x = np.clip(x, 0.0, 1.0).astype(np.float32)
+    if flip > 0:
+        flipped = rng.uniform(size=n) < flip
+        y = np.where(flipped, rng.integers(0, classes, size=n), y).astype(np.int32)
     return Dataset(x, y, classes, meta={"kind": "images", "synthetic": True})
 
 
@@ -320,7 +330,7 @@ class DatasetUtils:
                  for k, v in urllib.parse.parse_qs(parsed.query).items()}
             if parsed.netloc == "images":
                 return synthetic_images(**{k: q[k] for k in q if k in
-                                           ("classes", "w", "h", "c", "n", "seed", "noise", "dist")})
+                                           ("classes", "w", "h", "c", "n", "seed", "noise", "dist", "flip")})
             if parsed.netloc == "corpus":
                 kw = dict(q)
                 if "len" in kw:

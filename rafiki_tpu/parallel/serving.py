@@ -69,24 +69,41 @@ class StackedTrialModel:
         self._ens = None
 
 
+def _param_shape_tree(model) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(lambda a: (tuple(a.shape), str(a.dtype)),
+                                  model._loop.params)
+
+
 def try_build_stacked(trials: List[dict], models: List[Any],
                       devices: Optional[Sequence] = None,
                       batch_size: int = 64) -> Optional[StackedTrialModel]:
     """Return a stacked adapter when every trial is stackable, else None.
 
-    Stackable = same model template, same compiled-shape signature, and
-    a JaxModel-style loaded instance (module + params pytree).
+    Stackable = same model template, a JaxModel-style loaded instance
+    (module + params pytree), and IDENTICAL param tree shapes — the
+    exact predictor of whether k param sets can be stacked into one
+    vmapped program. Notably this is weaker than equal compiled-shape
+    signatures: the training-time shape signature includes knobs like
+    batch_size that change nothing about the serving architecture, and
+    gating on it would needlessly send stackable top-k sets down the
+    k-workers fallback. Width/depth differences DO differ in param
+    shapes and fall back. Dropout-rate differences vanish at eval time
+    (deterministic apply), so serving through the first model's module
+    is exact for all k.
     """
     if len(models) < 2:
         return None
-    sigs = {t.get("shape_sig") for t in trials}
-    names = {t.get("model_name") for t in trials}
-    if len(sigs) != 1 or None in sigs or len(names) != 1:
+    if len({t.get("model_name") for t in trials}) != 1:
         return None
     if not all(hasattr(m, "_module") and getattr(m, "_loop", None) is not None
                for m in models):
         return None
     try:
+        shapes0 = _param_shape_tree(models[0])
+        if any(_param_shape_tree(m) != shapes0 for m in models[1:]):
+            return None
         return StackedTrialModel(models, devices=devices, batch_size=batch_size)
     except Exception:
         return None  # any mismatch → caller falls back to per-trial workers

@@ -372,24 +372,26 @@ class ProcessScheduler:
             if stop_event.is_set():
                 for g in groups:
                     g.terminate()
+                stopped_services = set()
                 for g in groups:
                     g.collect(blame=lambda k, rc: False)
                     if g.respawn_at is None:
                         # Live group: its service row goes STOPPED. A
                         # group caught in its backoff window keeps the
-                        # ERRORED corpse row, and its orphaned trial is
-                        # terminated below — no replacement is coming,
-                        # and leaving it RUNNING would hand a trial of
-                        # an explicitly-stopped job to the periodic
-                        # recovery sweep.
+                        # ERRORED corpse row; either way the group's
+                        # orphaned trials are terminated below — no
+                        # replacement is coming, and leaving one RUNNING
+                        # would hand a trial of an explicitly-stopped
+                        # job to the periodic recovery sweep.
                         self.store.update_service(
                             g.service["id"],
                             status=ServiceStatus.STOPPED.value)
-                    for t in self.store.get_trials_of_sub_train_job(sub["id"]):
-                        if (t["status"] == TrialStatus.RUNNING.value
-                                and t.get("service_id") in (
-                                    {g.service["id"]} | set(g.dead_services))):
-                            self.store.mark_trial_as_terminated(t["id"])
+                    stopped_services.add(g.service["id"])
+                    stopped_services.update(g.dead_services)
+                for t in self.store.get_trials_of_sub_train_job(sub["id"]):
+                    if (t["status"] == TrialStatus.RUNNING.value
+                            and t.get("service_id") in stopped_services):
+                        self.store.mark_trial_as_terminated(t["id"])
                 groups.clear()
                 break
             now = time.monotonic()

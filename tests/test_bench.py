@@ -43,6 +43,32 @@ def test_bench_smoke_cpu():
     assert d["program_cache_hits"] >= 1
     assert d["advisor_s_per_trial_at_30obs"] >= 0
     assert "estimate" in d["baseline_basis"].lower()
+    # the accuracy clause is calibrated + gated, not decorative
+    assert d["top1_miss"] is False
+    assert d["best_top1"] >= d["top1_target"]
+    assert d["top1_ceiling"] < 0.9  # flip-noise ceiling, not a saturating task
+    # acceptance config 5 is an actual k>=2 ensemble, stacked path engaged
+    assert d["serving_k"] == 2
+    assert d["serving_path"] == "stacked"
+    assert d["serving_qps_stacked"] > 0
+    assert d["serving_qps_per_worker"] > 0
+    # GP-vs-random lift from real tiny trials is reported
+    assert "advisor_lift" in d
+    # honesty details
+    assert d["n_workers"] == 1
+    assert d["cold_trial_s"] >= d["steady_trial_s"]
+    assert "whole-program" in d["mfu_basis"]
+
+
+def test_bench_top1_gate_turns_red():
+    """An unreachable target must flip the bench to an error exit: the
+    accuracy clause is falsifiable, not decorative."""
+    rc, out = _run({"RAFIKI_BENCH_PLATFORM": "cpu", "RAFIKI_BENCH_TRIALS": "3",
+                    "RAFIKI_BENCH_TOP1_TARGET": "0.99"})
+    assert rc == 1
+    assert "below target" in out["error"]
+    assert out["detail"]["top1_miss"] is True
+    assert out["value"] > 0  # the measured headline still reported
 
 
 def test_bench_forced_failure_still_emits_json():
