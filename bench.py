@@ -425,6 +425,7 @@ def _measure_serving(store, params, result, sc: dict, detail: dict) -> None:
             detail["serving_batch_latency_stacked_ms"] = lat
         else:  # heterogeneous top-k: record it honestly, don't relabel
             detail["serving_qps_fallback_via_services_manager"] = qps
+            detail["serving_batch_latency_fallback_ms"] = lat
     finally:
         sm.stop_inference_services(inf["id"])
 
@@ -618,9 +619,13 @@ def main() -> None:
         if detail.get("top1_miss"):
             # The accuracy clause is a GATE, not a footnote: a learning
             # regression (or an advisor steering into bad regions) must
-            # turn the bench red, not quietly shave the headline.
-            _emit(error=(f"best_top1 {detail.get('best_top1')} below "
-                         f"target {sc['top1_target']} "
+            # turn the bench red, not quietly shave the headline. A
+            # None best_top1 is a job failure, not a regression — label
+            # it so triage starts at the right subsystem.
+            best = detail.get("best_top1")
+            _emit(error=("no completed trials scored — job/infra failure, "
+                         "see errored_trials" if best is None else
+                         f"best_top1 {best} below target {sc['top1_target']} "
                          f"(ceiling {detail.get('top1_ceiling')}) — "
                          "learning regression"))
             wd.cancel()

@@ -49,7 +49,9 @@ def main() -> None:
         depth, width, w, n_train, n_eval = 16, 1.0, 32, 50_000, 10_000
         lrs = [1e-4, 1e-3, 1e-2, 3e-2]
     else:
-        depth, width, w, n_train, n_eval = 11, 0.25, 8, 512, 256
+        # MUST mirror bench.py _scale()'s smoke task (train_n/eval_n/w/
+        # model) — these measurements justify that task's top1_target.
+        depth, width, w, n_train, n_eval = 11, 0.25, 8, 2048, 512
         lrs = [1e-4, 1e-3, 1e-2, 3e-2]
     dropouts = [0.0, 0.4]
 

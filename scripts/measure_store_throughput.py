@@ -1,0 +1,96 @@
+"""Measure the sqlite-WAL meta store's ceiling under racing workers.
+
+SURVEY.md §7 step 5 prescribed a store "swap-able for Postgres"; this
+deployment keeps sqlite-WAL (one TPU host drives the chips — the
+control plane is host-local) and instead DOCUMENTS its measured
+multi-process ceiling (docs/architecture.md "Meta-store scale"). This
+script produces that number: N worker PROCESSES (sqlite contention is
+cross-process file locking, so threads would flatter it) hammer one
+store with the real trial-loop write mix — atomic budget-claimed trial
+creation, per-epoch log appends, throttled heartbeats, completion
+marks — and the run asserts the budget invariant held (exactly
+max_trials trials) while reporting aggregate write-transactions/sec.
+
+Usage: python scripts/measure_store_throughput.py [n_workers] [trials]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _worker(db_path: str, sub_id: str, svc_id: str, max_trials: int,
+            logs_per_trial: int, out_q) -> None:
+    from rafiki_tpu.store import MetaStore
+
+    store = MetaStore(db_path)
+    ops = 0
+    t0 = time.monotonic()
+    while True:
+        t = store.create_trial(sub_id, "M", {"lr": 0.1}, worker_id=str(os.getpid()),
+                               service_id=svc_id, budget_max=max_trials)
+        ops += 1
+        if t is None:
+            break
+        for i in range(logs_per_trial):
+            store.add_trial_log(t["id"], {"epoch": i, "loss": 0.5})
+            ops += 1
+        store.update_service(svc_id, heartbeat=True)
+        store.mark_trial_as_completed(t["id"], 0.9, None)
+        ops += 2
+    out_q.put((ops, time.monotonic() - t0))
+
+
+def main() -> None:
+    n_workers = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    max_trials = int(sys.argv[2]) if len(sys.argv) > 2 else 400
+    logs_per_trial = 10
+    from rafiki_tpu.store import MetaStore
+
+    tmp = tempfile.mkdtemp(prefix="store-bench-")
+    db = os.path.join(tmp, "meta.sqlite3")
+    store = MetaStore(db)
+    model = store.create_model("m", "T", None, b"x", "M")
+    job = store.create_train_job("app", "T", None, "t", "v",
+                                 {"MODEL_TRIAL_COUNT": max_trials})
+    sub = store.create_sub_train_job(job["id"], model["id"])
+    services = [store.create_service("TRAIN_WORKER") for _ in range(n_workers)]
+
+    q = mp.Queue()
+    procs = [mp.Process(target=_worker,
+                        args=(db, sub["id"], services[i]["id"], max_trials,
+                              logs_per_trial, q))
+             for i in range(n_workers)]
+    t0 = time.monotonic()
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=300) for _ in procs]
+    for p in procs:
+        p.join()
+    wall = time.monotonic() - t0
+
+    trials = store.get_trials_of_sub_train_job(sub["id"])
+    assert len(trials) == max_trials, f"budget violated: {len(trials)}"
+    assert all(t["status"] == "COMPLETED" for t in trials)
+    total_ops = sum(r[0] for r in results)
+    print(json.dumps({
+        "n_worker_processes": n_workers,
+        "trials": max_trials,
+        "logs_per_trial": logs_per_trial,
+        "wall_s": round(wall, 2),
+        "write_txn_per_s": round(total_ops / wall, 1),
+        "trials_per_s": round(max_trials / wall, 1),
+        "budget_exact": True,
+    }))
+
+
+if __name__ == "__main__":
+    main()

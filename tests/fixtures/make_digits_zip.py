@@ -1,0 +1,75 @@
+"""Generate the committed digit-zip fixtures (reference dataset format).
+
+The reference's image datasets are zips of real image files plus an
+``images.csv`` of ``path,class`` rows (SURVEY.md §2 dataset-utils row).
+These fixtures are REAL raster images — 16x16 grayscale PNGs of digit
+glyphs rendered from a 5x7 bitmap font at jittered offsets with light
+pixel noise — so the end-to-end zip path (decode, normalize, batch,
+train, predict) is proven on actual image files rather than on the
+synthetic:// generator.
+
+Run from the repo root to (re)generate:
+  python tests/fixtures/make_digits_zip.py
+Writes tests/fixtures/digits_train.zip (200 images) and
+tests/fixtures/digits_val.zip (60 images), both committed.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import zipfile
+
+import numpy as np
+
+# A classic 5x7 bitmap font for the digits 0-9.
+_FONT = {
+    0: ["01110", "10001", "10011", "10101", "11001", "10001", "01110"],
+    1: ["00100", "01100", "00100", "00100", "00100", "00100", "01110"],
+    2: ["01110", "10001", "00001", "00010", "00100", "01000", "11111"],
+    3: ["11111", "00010", "00100", "00010", "00001", "10001", "01110"],
+    4: ["00010", "00110", "01010", "10010", "11111", "00010", "00010"],
+    5: ["11111", "10000", "11110", "00001", "00001", "10001", "01110"],
+    6: ["00110", "01000", "10000", "11110", "10001", "10001", "01110"],
+    7: ["11111", "00001", "00010", "00100", "01000", "01000", "01000"],
+    8: ["01110", "10001", "10001", "01110", "10001", "10001", "01110"],
+    9: ["01110", "10001", "10001", "01111", "00001", "00010", "01100"],
+}
+SIZE = 16
+
+
+def _render(digit: int, rng: np.random.Generator) -> np.ndarray:
+    glyph = np.array([[int(c) for c in row] for row in _FONT[digit]],
+                     dtype=np.float32)
+    # 2x upscale to 10x14, jittered placement on a 16x16 canvas.
+    glyph = np.repeat(np.repeat(glyph, 2, axis=0), 2, axis=1)
+    canvas = np.zeros((SIZE, SIZE), dtype=np.float32)
+    oy = rng.integers(0, SIZE - glyph.shape[0] + 1)
+    ox = rng.integers(0, SIZE - glyph.shape[1] + 1)
+    canvas[oy:oy + glyph.shape[0], ox:ox + glyph.shape[1]] = glyph
+    canvas += rng.normal(0, 0.08, canvas.shape).astype(np.float32)
+    return (np.clip(canvas, 0, 1) * 255).astype(np.uint8)
+
+
+def make_zip(path: str, n: int, seed: int) -> None:
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    rows = ["path,class"]
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        for i in range(n):
+            digit = int(rng.integers(0, 10))
+            img = Image.fromarray(_render(digit, rng), mode="L")
+            buf = io.BytesIO()
+            img.save(buf, format="PNG")
+            name = f"images/{i:04d}.png"
+            zf.writestr(name, buf.getvalue())
+            rows.append(f"{name},{digit}")
+        zf.writestr("images.csv", "\n".join(rows) + "\n")
+
+
+if __name__ == "__main__":
+    here = os.path.dirname(os.path.abspath(__file__))
+    make_zip(os.path.join(here, "digits_train.zip"), n=200, seed=0)
+    make_zip(os.path.join(here, "digits_val.zip"), n=60, seed=1)
+    print("wrote digits_train.zip (200) and digits_val.zip (60)")
