@@ -170,12 +170,52 @@ def test_web_ui_served(server):
     assert "text/html" in resp.headers["Content-Type"]
     assert "rafiki-tpu" in resp.text and "login-form" in resp.text
     # the parity surfaces: per-trial metric plots (define_plot channel),
-    # trial-log viewer, stop controls for train + inference jobs
+    # trial-log viewer, stop controls for train + inference jobs, and
+    # the full browser journey: model upload, train-job creation, user
+    # create/ban (every client verb is browser-drivable)
     for marker in ("renderTrial", "linePlot", "Trial log", "stop-job",
-                   "stop-inf", "</html>"):
+                   "stop-inf", "new-model", "new-job", "new-user",
+                   'class="ghost ban"', "</html>"):
         assert marker in resp.text, f"web UI missing {marker!r}"
     # balanced script block (a truncated inline script serves silently)
     assert resp.text.count("<script>") == resp.text.count("</script>") == 1
+
+
+def test_web_ui_form_calls(server, superadmin, tmp_config):
+    """The exact REST calls the web UI forms issue (JSON bodies, not
+    the SDK's multipart): upload a model template, create a train job,
+    create and ban a user."""
+    import requests
+
+    base = f"http://127.0.0.1:{server}"
+    tok = requests.post(f"{base}/tokens", json={
+        "email": tmp_config.superadmin_email,
+        "password": tmp_config.superadmin_password}).json()["token"]
+    h = {"Authorization": f"Bearer {tok}"}
+
+    r = requests.post(f"{base}/models", headers=h, json={
+        "name": "ui-upload", "task": "IMAGE_CLASSIFICATION",
+        "model_class": "TinyFF", "model_file": FF_SOURCE.decode(),
+        "access_right": "PRIVATE"})
+    assert r.status_code == 201, r.text
+    assert any(m["name"] == "ui-upload" for m in
+               requests.get(f"{base}/models", headers=h).json())
+
+    r = requests.post(f"{base}/train_jobs", headers=h, json={
+        "app": "ui-app", "task": "IMAGE_CLASSIFICATION",
+        "train_dataset_uri": TRAIN, "val_dataset_uri": VAL,
+        "budget": {"MODEL_TRIAL_COUNT": 1}, "advisor_kind": "random"})
+    assert r.status_code == 201, r.text
+    superadmin.wait_until_train_job_has_stopped("ui-app", timeout=180,
+                                                poll_s=0.5)
+
+    r = requests.post(f"{base}/users", headers=h, json={
+        "email": "banme@x.y", "password": "pw", "user_type": "APP_DEVELOPER"})
+    assert r.status_code in (200, 201), r.text
+    r = requests.delete(f"{base}/users", headers=h, json={"email": "banme@x.y"})
+    assert r.status_code == 200, r.text
+    users = requests.get(f"{base}/users", headers=h).json()
+    assert next(u for u in users if u["email"] == "banme@x.y")["banned"]
 
 
 def test_404s(server, superadmin):
