@@ -306,7 +306,21 @@ class JaxModel(BaseModel):
         if self._loop is None:
             raise RuntimeError("Model has no parameters: call train() or load_parameters() first")
         ds = self._prepared_dataset(dataset_uri)
+        self._check_label_space(ds)
         return float(self._loop.evaluate(ds, self.batch_size))
+
+    def _check_label_space(self, ds: Dataset) -> None:
+        """Fail loudly when an eval dataset's LABEL MEANING diverges
+        from the train dataset's. Class counts alone cannot catch a
+        corpus whose tag set differs but has the same cardinality: the
+        loader's sorted tag ids would shift and every score would be
+        silently computed against wrong labels."""
+        train_tags = self._dataset_meta.get("tag_map")
+        eval_tags = ds.meta.get("tag_map")
+        if train_tags and eval_tags and train_tags != eval_tags:
+            raise ValueError(
+                f"Eval dataset tag map {eval_tags} != train tag map "
+                f"{train_tags}; the datasets label different tag sets")
 
     def predict(self, queries: List[Any]) -> List[List[float]]:
         if self._loop is None:
@@ -337,8 +351,7 @@ class JaxModel(BaseModel):
         payload = {
             "arch": self._arch,
             "packed": dump_pytree(self._loop.params, cast_f32_to_bf16=cast),
-            "dataset_meta": {k: v for k, v in self._dataset_meta.items()
-                              if isinstance(v, (str, int, float, bool))},
+            "dataset_meta": _portable_meta(self._dataset_meta),
         }
         return pickle.dumps(payload)
 
@@ -391,8 +404,7 @@ class JaxModel(BaseModel):
             "state_packed": dump_pytree(self._loop.state, cast_f32_to_bf16=False),
             "epoch": getattr(self, "_epochs_done", 0),
             "planned_steps": getattr(self, "_planned_steps", None),
-            "dataset_meta": {k: v for k, v in self._dataset_meta.items()
-                             if isinstance(v, (str, int, float, bool))},
+            "dataset_meta": _portable_meta(self._dataset_meta),
         }
         return pickle.dumps(payload)
 
@@ -435,6 +447,17 @@ class JaxModel(BaseModel):
 # ---------------------------------------------------------------------------
 # Model file loading (reference: load_model_class executes uploaded .py)
 # ---------------------------------------------------------------------------
+
+def _portable_meta(meta: Dict[str, Any]) -> Dict[str, Any]:
+    """The dataset-meta slice worth persisting in params/checkpoint
+    blobs: scalars, plus the label-space signature (``tag_map``) so a
+    restored model still fails loudly on a mismatched eval dataset."""
+    out = {k: v for k, v in meta.items()
+           if isinstance(v, (str, int, float, bool))}
+    if isinstance(meta.get("tag_map"), dict):
+        out["tag_map"] = dict(meta["tag_map"])
+    return out
+
 
 def load_model_class(model_file_bytes: bytes, model_class: str,
                      temp_mod_name: Optional[str] = None) -> type:

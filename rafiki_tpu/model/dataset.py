@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import csv
 import io
+import json
 import os
 import urllib.parse
 import zipfile
@@ -207,9 +208,37 @@ def load_dataset_of_image_files(uri: str) -> Dataset:
     return Dataset(x, y, classes=int(y.max()) + 1, meta={"kind": "images", "uri": uri})
 
 
+# Canonical corpus encoding: ids must be DETERMINISTIC FUNCTIONS OF THE
+# TEXT, not of one zip's iteration order — a train zip and a val zip are
+# loaded independently (the model contract passes separate URIs), and
+# first-seen-order vocabularies would silently map the same token or
+# tag to different ids across the two, corrupting every evaluation.
+#   * tokens: feature-hashed into a fixed table (same token → same id
+#     in any zip; unseen val tokens get an arbitrary-but-consistent
+#     bucket instead of crashing — the standard OOV story);
+#   * tags: alphabetical (train/val splits of one corpus share the tag
+#     set, and sorted order is content-determined);
+#   * length: one fixed bucket (static shapes — one XLA program for
+#     every zip; longer sentences truncate, the mask stays exact).
+CORPUS_HASH_VOCAB = 8192
+CORPUS_MAX_LEN = 64
+
+
+def corpus_token_id(token: str) -> int:
+    """Stable token id in [1, CORPUS_HASH_VOCAB): blake2b feature hash
+    (0 is reserved for padding). Use this to build predict() queries
+    from raw tokens — it is the same mapping the corpus loader applies."""
+    import hashlib
+
+    h = int.from_bytes(
+        hashlib.blake2b(token.encode("utf-8"), digest_size=8).digest(), "big")
+    return 1 + h % (CORPUS_HASH_VOCAB - 1)
+
+
 def load_dataset_of_corpus(uri: str, tag_col: str = "tag") -> Dataset:
     """Load the reference's corpus-zip format: a TSV ``corpus.tsv`` of
-    token/tag rows with blank lines between sentences."""
+    token/tag rows with blank lines between sentences. Encoding is
+    canonical (see above) so separately-loaded train/val zips agree."""
     path = _resolve_path(uri)
     if path.endswith(".npz"):
         return _load_npz(path, kind="corpus")
@@ -229,25 +258,21 @@ def load_dataset_of_corpus(uri: str, tag_col: str = "tag") -> Dataset:
                 cur.append((tok, tag))
             if cur:
                 sents.append(cur)
-    vocab: Dict[str, int] = {"<pad>": 0}
-    tagset: Dict[str, int] = {}
-    for s in sents:
-        for tok, tag in s:
-            vocab.setdefault(tok, len(vocab))
-            tagset.setdefault(tag, len(tagset))
-    length = max(len(s) for s in sents)
+    tagset = {t: i for i, t in enumerate(sorted(
+        {tag for s in sents for _, tag in s}))}
+    length = CORPUS_MAX_LEN
     n = len(sents)
     x = np.zeros((n, length), dtype=np.int32)
     y = np.full((n, length), -1, dtype=np.int32)
     mask = np.zeros((n, length), dtype=bool)
     for i, s in enumerate(sents):
-        for j, (tok, tag) in enumerate(s):
-            x[i, j] = vocab[tok]
+        for j, (tok, tag) in enumerate(s[:length]):
+            x[i, j] = corpus_token_id(tok)
             y[i, j] = tagset[tag]
             mask[i, j] = True
     return Dataset(x, y, classes=len(tagset), mask=mask,
-                   meta={"kind": "corpus", "uri": uri, "vocab": len(vocab),
-                         "vocab_map": vocab, "tag_map": tagset})
+                   meta={"kind": "corpus", "uri": uri,
+                         "vocab": CORPUS_HASH_VOCAB, "tag_map": tagset})
 
 
 def _load_npz(path: str, kind: str) -> Dataset:
@@ -255,12 +280,21 @@ def _load_npz(path: str, kind: str) -> Dataset:
         x = z["x"]
         y = z["y"].astype(np.int32)
         mask = z["mask"] if "mask" in z else None
+        saved_meta = (json.loads(str(z["meta_json"]))
+                      if "meta_json" in z else {})
     classes = int(y.max()) + 1 if kind == "images" else int(y[y >= 0].max()) + 1
+    if saved_meta.get("classes"):
+        classes = int(saved_meta.pop("classes"))
     if kind == "images" and x.dtype == np.uint8:
         x = x.astype(np.float32) / 255.0
     meta = {"kind": kind, "uri": path}
     if kind == "corpus":
+        # Legacy derivation only when the npz carries no meta: a hashed
+        # corpus saved via save_npz MUST keep its fixed table size —
+        # max-observed-id+1 would shrink the embedding below ids that
+        # corpus_token_id() can legitimately produce for new queries.
         meta["vocab"] = int(x.max()) + 1
+    meta.update(saved_meta)
     return Dataset(x, y, classes=classes, mask=mask, meta=meta)
 
 
@@ -358,6 +392,15 @@ class DatasetUtils:
         arrays = {"x": dataset.x, "y": dataset.y}
         if dataset.mask is not None:
             arrays["mask"] = dataset.mask
+        # Persist the json-able meta (vocab size, tag_map, classes):
+        # without it a reloaded hashed corpus would re-derive vocab as
+        # max-observed-id+1 and lose the label-space signature.
+        portable = {k: v for k, v in dataset.meta.items()
+                    if isinstance(v, (str, int, float, bool))}
+        if isinstance(dataset.meta.get("tag_map"), dict):
+            portable["tag_map"] = dataset.meta["tag_map"]
+        portable["classes"] = dataset.classes
+        arrays["meta_json"] = np.asarray(json.dumps(portable))
         np.savez_compressed(path, **arrays)
         return path
 

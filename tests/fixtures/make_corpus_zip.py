@@ -1,0 +1,97 @@
+"""Generate the committed POS-corpus zip fixtures (reference format).
+
+The reference's POS datasets are zips holding a ``corpus.tsv`` of
+token<TAB>tag rows with blank lines between sentences (SURVEY.md §2
+dataset-utils row). The fixtures below are REAL text: hand-tagged
+English sentences (universal-style tags), split into train/val zips so
+the end-to-end corpus path — canonical hashing/tag encoding across
+independently loaded zips, masking, training, prediction — is proven
+on actual language data rather than the synthetic token generator.
+
+Run from the repo root to (re)generate:
+  python tests/fixtures/make_corpus_zip.py
+Writes tests/fixtures/pos_train.zip and tests/fixtures/pos_val.zip.
+"""
+
+from __future__ import annotations
+
+import os
+import zipfile
+
+# (token, tag) sentences; universal-style tagset:
+# DET NOUN VERB ADJ ADV PRON ADP CONJ NUM PRT PUNCT
+_SENTENCES = [
+    "the/DET cat/NOUN sat/VERB on/ADP the/DET mat/NOUN ./PUNCT",
+    "a/DET dog/NOUN barked/VERB loudly/ADV ./PUNCT",
+    "she/PRON reads/VERB old/ADJ books/NOUN ./PUNCT",
+    "the/DET quick/ADJ fox/NOUN jumps/VERB over/ADP the/DET lazy/ADJ dog/NOUN ./PUNCT",
+    "he/PRON ate/VERB two/NUM green/ADJ apples/NOUN ./PUNCT",
+    "birds/NOUN fly/VERB south/ADV in/ADP winter/NOUN ./PUNCT",
+    "we/PRON walked/VERB to/ADP the/DET market/NOUN and/CONJ bought/VERB bread/NOUN ./PUNCT",
+    "the/DET old/ADJ man/NOUN smiled/VERB warmly/ADV ./PUNCT",
+    "children/NOUN play/VERB in/ADP the/DET park/NOUN ./PUNCT",
+    "it/PRON rained/VERB heavily/ADV all/DET night/NOUN ./PUNCT",
+    "three/NUM ships/NOUN sailed/VERB across/ADP the/DET sea/NOUN ./PUNCT",
+    "they/PRON sang/VERB a/DET happy/ADJ song/NOUN ./PUNCT",
+    "the/DET teacher/NOUN wrote/VERB on/ADP the/DET board/NOUN ./PUNCT",
+    "my/DET sister/NOUN likes/VERB red/ADJ flowers/NOUN ./PUNCT",
+    "he/PRON quickly/ADV closed/VERB the/DET heavy/ADJ door/NOUN ./PUNCT",
+    "the/DET river/NOUN flows/VERB through/ADP the/DET valley/NOUN ./PUNCT",
+    "we/PRON saw/VERB five/NUM small/ADJ boats/NOUN ./PUNCT",
+    "the/DET sun/NOUN rises/VERB in/ADP the/DET east/NOUN ./PUNCT",
+    "she/PRON gave/VERB him/PRON a/DET new/ADJ pen/NOUN ./PUNCT",
+    "farmers/NOUN grow/VERB wheat/NOUN and/CONJ corn/NOUN ./PUNCT",
+    "the/DET baby/NOUN slept/VERB quietly/ADV upstairs/ADV ./PUNCT",
+    "i/PRON drank/VERB cold/ADJ water/NOUN after/ADP the/DET race/NOUN ./PUNCT",
+    "dark/ADJ clouds/NOUN covered/VERB the/DET sky/NOUN ./PUNCT",
+    "the/DET train/NOUN arrived/VERB late/ADV again/ADV ./PUNCT",
+    "you/PRON should/VERB try/VERB the/DET soup/NOUN ./PUNCT",
+    "a/DET tall/ADJ tree/NOUN fell/VERB during/ADP the/DET storm/NOUN ./PUNCT",
+    "the/DET chef/NOUN cooked/VERB fresh/ADJ fish/NOUN ./PUNCT",
+    "wolves/NOUN hunt/VERB in/ADP packs/NOUN ./PUNCT",
+    "her/DET voice/NOUN sounded/VERB very/ADV calm/ADJ ./PUNCT",
+    "the/DET clock/NOUN struck/VERB nine/NUM ./PUNCT",
+    "students/NOUN study/VERB hard/ADV before/ADP exams/NOUN ./PUNCT",
+    "he/PRON painted/VERB the/DET fence/NOUN white/ADJ ./PUNCT",
+    "the/DET wind/NOUN blew/VERB the/DET leaves/NOUN away/ADV ./PUNCT",
+    "they/PRON built/VERB a/DET stone/NOUN bridge/NOUN ./PUNCT",
+    "snow/NOUN fell/VERB softly/ADV on/ADP the/DET hills/NOUN ./PUNCT",
+    "the/DET girl/NOUN found/VERB a/DET shiny/ADJ coin/NOUN ./PUNCT",
+    "bees/NOUN make/VERB sweet/ADJ honey/NOUN ./PUNCT",
+    "we/PRON waited/VERB for/ADP the/DET bus/NOUN ./PUNCT",
+    "the/DET moon/NOUN glowed/VERB brightly/ADV above/ADP the/DET lake/NOUN ./PUNCT",
+    "old/ADJ houses/NOUN need/VERB constant/ADJ care/NOUN ./PUNCT",
+    # -- validation split (same tag set, overlapping vocabulary) --
+    "the/DET dog/NOUN sat/VERB near/ADP the/DET door/NOUN ./PUNCT",
+    "she/PRON likes/VERB the/DET old/ADJ park/NOUN ./PUNCT",
+    "two/NUM birds/NOUN sang/VERB in/ADP the/DET tree/NOUN ./PUNCT",
+    "he/PRON reads/VERB books/NOUN quietly/ADV ./PUNCT",
+    "the/DET children/NOUN play/VERB near/ADP the/DET river/NOUN ./PUNCT",
+    "cold/ADJ wind/NOUN blew/VERB through/ADP the/DET valley/NOUN ./PUNCT",
+    "they/PRON bought/VERB fresh/ADJ bread/NOUN and/CONJ honey/NOUN ./PUNCT",
+    "the/DET man/NOUN walked/VERB to/ADP the/DET lake/NOUN ./PUNCT",
+]
+N_VAL = 8
+
+
+def _tsv(sentences) -> str:
+    blocks = []
+    for s in sentences:
+        rows = [pair.rsplit("/", 1) for pair in s.split()]
+        blocks.append("\n".join(f"{tok}\t{tag}" for tok, tag in rows))
+    return "\n\n".join(blocks) + "\n"
+
+
+def make_zips(out_dir: str) -> None:
+    train, val = _SENTENCES[:-N_VAL], _SENTENCES[-N_VAL:]
+    for name, sents in (("pos_train.zip", train), ("pos_val.zip", val)):
+        with zipfile.ZipFile(os.path.join(out_dir, name), "w",
+                             zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("corpus.tsv", _tsv(sents))
+
+
+if __name__ == "__main__":
+    here = os.path.dirname(os.path.abspath(__file__))
+    make_zips(here)
+    print(f"wrote pos_train.zip ({len(_SENTENCES) - N_VAL} sentences) and "
+          f"pos_val.zip ({N_VAL})")
