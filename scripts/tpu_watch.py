@@ -1,0 +1,122 @@
+"""Opportunistic TPU evidence capture (round-5 directive 1).
+
+The TPU tunnel on this machine is flaky: the driver's bench window hit
+it down in rounds 3 and 4, and nothing in-repo recorded whether it was
+ever up during the builder's session. This watcher makes hardware
+evidence capture durable:
+
+  * probes the backend in a throwaway subprocess (jax backend init has
+    no timeout and hangs when the tunnel is down) on a loop;
+  * appends EVERY attempt to TUNNEL_LOG.jsonl — committed, so a
+    down-all-session outage is provable, not just claimed;
+  * the FIRST time the probe is green, runs the full bench
+    (compile-inclusive) -> BENCH_SELF_r05.json, then the canonical-task
+    calibration -> CALIBRATION_TPU.json, commits all three artifacts
+    with `git commit -- <paths>` (leaves unrelated staged work alone),
+    and exits 0.
+
+Run: python scripts/tpu_watch.py   (backgrounded; exits only on green
+capture, so a nonzero-uptime session always ends with committed
+hardware numbers and a zero-uptime session ends with a committed probe
+log proving it).
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TUNNEL_LOG.jsonl")
+BENCH_OUT = os.path.join(REPO, "BENCH_SELF_r05.json")
+CAL_OUT = os.path.join(REPO, "CALIBRATION_TPU.json")
+PROBE_CODE = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+PROBE_TIMEOUT_S = 90
+SLEEP_S = 540  # ~9 min between probes; ~10.5 min cycle when down
+
+
+def _log(rec: dict) -> None:
+    rec = {"iso": datetime.datetime.now(datetime.timezone.utc)
+           .isoformat(timespec="seconds"), **rec}
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def probe() -> tuple[bool, str]:
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE_CODE],
+                           capture_output=True, text=True,
+                           timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {PROBE_TIMEOUT_S}s (tunnel down)"
+    if r.returncode != 0:
+        return False, f"rc={r.returncode}: {r.stderr.strip()[-300:]}"
+    return True, r.stdout.strip()
+
+
+def _run(label: str, cmd: list[str], timeout_s: float) -> tuple[int, str, str]:
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout_s, cwd=REPO)
+        rc, out, err = r.returncode, r.stdout, r.stderr
+    except subprocess.TimeoutExpired as ex:
+        rc = -9
+        out = (ex.stdout or b"").decode("utf-8", "replace") \
+            if isinstance(ex.stdout, bytes) else (ex.stdout or "")
+        err = f"timed out after {timeout_s:.0f}s"
+    _log({"event": label, "rc": rc, "wall_s": round(time.time() - t0, 1),
+          "stderr_tail": err.strip()[-300:]})
+    return rc, out, err
+
+
+def capture() -> bool:
+    """Green window: bench first (the headline artifact), calibration
+    second (tunnel may drop mid-window), then commit what we got."""
+    rc, out, _ = _run("bench", [sys.executable, "bench.py"], timeout_s=2100)
+    got_bench = False
+    lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
+    if lines:
+        with open(BENCH_OUT, "w") as f:
+            f.write(lines[-1] + "\n")
+        got_bench = True
+        _log({"event": "bench_saved", "rc": rc,
+              "headline": json.loads(lines[-1]).get("value")})
+
+    rc2, out2, _ = _run("calibration",
+                        [sys.executable, "scripts/calibrate_bench_task.py",
+                         "--canonical"], timeout_s=3000)
+    got_cal = False
+    if rc2 == 0 and out2.strip():
+        with open(CAL_OUT, "w") as f:
+            f.write(out2)
+        got_cal = True
+
+    paths = [LOG] + ([BENCH_OUT] if got_bench else []) \
+        + ([CAL_OUT] if got_cal else [])
+    subprocess.run(["git", "add"] + paths, cwd=REPO)
+    subprocess.run(["git", "commit", "-m",
+                    "Self-captured TPU evidence: bench%s + tunnel log"
+                    % (" + calibration" if got_cal else ""),
+                    "--"] + paths, cwd=REPO)
+    _log({"event": "committed", "bench": got_bench, "calibration": got_cal})
+    return got_bench
+
+
+def main() -> None:
+    n = 0
+    while True:
+        n += 1
+        up, msg = probe()
+        _log({"event": "probe", "n": n, "up": up, "msg": msg})
+        if up and capture():
+            return
+        time.sleep(SLEEP_S)
+
+
+if __name__ == "__main__":
+    main()
