@@ -34,15 +34,19 @@ regions turns the bench red instead of shaving the headline silently.
 The canonical-scale target (0.70) is provisional pending a TPU
 calibration run (`scripts/calibrate_bench_task.py --canonical`).
 
-Also reported (detail): steady-state trials/hour over the warm tail,
-cold (first-completed) and slowest trial durations, per-step training
-throughput and MFU vs the v5e's 197 TFLOP/s bf16 peak (MFU basis: XLA
-whole-program flops — overstates vs the conventional model-flops MFU),
-advisor cost measured POST-GP-fit (>=30 observations), a GP-vs-random
-``advisor_lift`` from tiny-but-real trials, params dump time,
-program/compile-cache statistics, and acceptance config 5 served BOTH
-ways: the reference-shaped one-worker-per-trial ensemble and
-ServicesManager's stacked top-k path (one vmapped XLA program).
+Also reported (detail): steady-state trials/hour (median over trials
+that STARTED after the last program-cache miss — stragglers included;
+null when no trial ran fully warm), wall_s_to_top1_target (first
+wall-clock moment any trial crossed the accuracy target — the north
+star's time-to-accuracy clause), cold (first-completed) and slowest
+trial durations, per-step training throughput, TWO MFU figures vs the
+v5e's 197 TFLOP/s bf16 peak (XLA whole-program flops AND analytic
+conv+dense model flops; both null off-TPU), advisor cost measured
+POST-GP-fit (>=30 observations), a GP-vs-random ``advisor_lift`` over
+>=3 seeds with its dispersion, params dump time, program/compile-cache
+statistics, and acceptance config 5 served BOTH ways: the
+reference-shaped one-worker-per-trial ensemble and ServicesManager's
+stacked top-k path (one vmapped XLA program).
 
 vs_baseline: the 120 trials/hour/GPU denominator is an ESTIMATE
 (BASELINE.md §Baseline derivation: V100 mixed-precision VGG16
@@ -227,7 +231,8 @@ def _scale(platform: str) -> dict:
     # scripts/calibrate_bench_task.py (see module docstring): flip=0.2
     # puts the accuracy ceiling at 0.82; targets sit below the measured
     # good-config scores and well above the ~0.1 chance floor.
-    common = dict(noise=0.35, flip=0.2, lift_trials=12, lift_warmup=4)
+    common = dict(noise=0.35, flip=0.2, lift_trials=12, lift_warmup=4,
+                  lift_seeds=3, platform=platform)
     if platform == "cpu":  # smoke run for tests: seconds, not minutes
         return dict(src=BENCH_MODEL_SRC_SMOKE, train_n=2048, eval_n=512,
                     w=8, trials=int(os.environ.get("RAFIKI_BENCH_TRIALS", "3")),
@@ -269,6 +274,7 @@ def run_real_loop(sc: dict, detail: dict) -> None:
         store.create_sub_train_job(job["id"], model["id"])
 
         cache0 = program_cache_stats()
+        wall0 = time.time()  # epoch clock, comparable to trial rows
         t0 = time.monotonic()
         result = LocalScheduler(store, params).run_train_job(
             job["id"], n_workers=1, advisor_kind="gp")
@@ -293,12 +299,23 @@ def run_real_loop(sc: dict, detail: dict) -> None:
                    key=lambda t: t["stopped_at"])
     durations = [t["stopped_at"] - t["started_at"] for t in timed]
     per_trial = sorted(durations)
-    # Steady state = the warm tail: trials after every shape bucket has
-    # compiled. Median of the fastest half is robust to stragglers.
-    tail = per_trial[: max(1, len(per_trial) // 2)]
-    steady_s = tail[len(tail) // 2] if tail else float("nan")
+    # Steady state = trials that ran ENTIRELY after the last cold
+    # compile (started after the final program-cache miss), stragglers
+    # included — the r4 "median of the fastest half" definition
+    # excluded stragglers by construction and flattered the claim.
+    # None when no trial ran fully warm (honest: no steady evidence).
+    last_miss = cache1.get("last_miss_ts", 0.0)
+    warm = sorted(t["stopped_at"] - t["started_at"] for t in timed
+                  if t["started_at"] > last_miss)
+    steady_s = warm[len(warm) // 2] if warm else None
 
     best_top1 = max((t["score"] for t in done), default=None)
+    # North-star clause 2 analog: first wall-clock moment any trial's
+    # score crossed the target, measured from job submission.
+    hits = [t["stopped_at"] for t in done
+            if t.get("score") is not None and t.get("stopped_at")
+            and t["score"] >= sc["top1_target"]]
+    wall_to_target = round(min(hits) - wall0, 2) if hits else None
     detail.update({
         "measured_trials": len(done),
         "errored_trials": len(result.trials) - len(done),
@@ -307,8 +324,11 @@ def run_real_loop(sc: dict, detail: dict) -> None:
         "measured_trials_per_hour": round(3600.0 * len(done) / wall, 2),
         "cold_trial_s": round(durations[0], 2) if durations else None,
         "slowest_trial_s": round(per_trial[-1], 2) if per_trial else None,
-        "steady_trial_s": round(steady_s, 3),
-        "steady_trials_per_hour": round(3600.0 / steady_s, 2) if steady_s > 0 else None,
+        "steady_trial_s": round(steady_s, 3) if steady_s is not None else None,
+        "steady_trials_n": len(warm),
+        "steady_trials_per_hour": (round(3600.0 / steady_s, 2)
+                                   if steady_s else None),
+        "wall_s_to_top1_target": wall_to_target,
         "best_top1": best_top1,
         "top1_target": sc["top1_target"],
         "top1_ceiling": round((1 - sc["flip"]) + sc["flip"] / 10, 3),
@@ -489,15 +509,52 @@ def run_advisor_lift(sc: dict, detail: dict) -> None:
         return scores
 
     kc = cls.get_knob_config()
-    s_gp = sweep(GpAdvisor(kc, seed=0, n_initial=warmup))
-    s_rnd = sweep(RandomAdvisor(kc, seed=1))
     mean = lambda xs: sum(xs) / len(xs)
-    detail["advisor_lift"] = round(mean(s_gp[warmup:]) - mean(s_rnd[warmup:]), 4)
-    detail["advisor_lift_best"] = round(max(s_gp) - max(s_rnd), 4)
-    detail["advisor_lift_trials"] = n
+    # >=3 seeds with dispersion (r4 directive 8): a one-seed lift at
+    # smoke scale is within noise; the claim must carry its spread.
+    lifts, best_lifts = [], []
+    for s in range(sc["lift_seeds"]):
+        s_gp = sweep(GpAdvisor(kc, seed=s, n_initial=warmup))
+        s_rnd = sweep(RandomAdvisor(kc, seed=100 + s))
+        lifts.append(round(mean(s_gp[warmup:]) - mean(s_rnd[warmup:]), 4))
+        best_lifts.append(round(max(s_gp) - max(s_rnd), 4))
+    m_lift = mean(lifts)
+    spread = max(abs(l - m_lift) for l in lifts)
+    detail["advisor_lift"] = round(m_lift, 4)
+    detail["advisor_lift_spread"] = round(spread, 4)
+    detail["advisor_lift_per_seed"] = lifts
+    # significant only when the whole dispersion band clears zero
+    detail["advisor_lift_significant"] = (m_lift - spread) > 0
+    detail["advisor_lift_best"] = round(mean(best_lifts), 4)
+    detail["advisor_lift_trials"] = n * sc["lift_seeds"]
 
 
 # -- microbench: step throughput, MFU, advisor, dump ------------------------
+
+
+def _vgg_train_flops_per_image(depth: int, width_mult: float, w: int,
+                               num_classes: int = 10) -> float:
+    """Analytic conv+dense flops (2*MACs) for one image's forward pass
+    through ``models/vgg._Vgg``, tripled for the train step (backward
+    ~= 2x forward for conv/dense — the conventional model-flops MFU
+    numerator, vs XLA's whole-program count which also bills norms,
+    pooling, optimizer update and padding)."""
+    from rafiki_tpu.models.vgg import _CFGS
+
+    h = wd = w
+    cin, fwd = 3, 0.0
+    for v in _CFGS[depth]:
+        if v == "M":
+            if min(h, wd) >= 2:
+                h, wd = h // 2, wd // 2
+            continue
+        cout = max(8, int(v * width_mult))
+        fwd += 2.0 * h * wd * cout * cin * 9  # 3x3 SAME conv
+        cin = cout
+    d1 = max(64, int(512 * width_mult))
+    fwd += 2.0 * (h * wd * cin) * d1
+    fwd += 2.0 * d1 * num_classes
+    return 3.0 * fwd
 
 
 def run_micro(sc: dict, detail: dict) -> None:
@@ -544,17 +601,24 @@ def run_micro(sc: dict, detail: dict) -> None:
     int(jax.device_get(c))
     eval_img_s = max(10, steps // 3) * batch / (time.monotonic() - t0)
 
-    # MFU from XLA's own cost model when available, else n/a.
-    mfu = None
-    try:
-        compiled = loop._train_step.lower(loop.state, dev_b).compile()
-        ca = compiled.cost_analysis()
-        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        flops = float(ca.get("flops", 0.0))
-        if flops > 0:
-            mfu = flops / step_s / V5E_BF16_PEAK_FLOPS
-    except Exception:
-        pass
+    # MFU only means something on the hardware whose peak is the
+    # denominator: off-TPU both fields are null, not a rounded 0.0
+    # (r4 verdict, Weak #2).
+    on_tpu = sc["platform"] == "tpu"
+    mfu = mfu_model = None
+    if on_tpu:
+        try:  # whole-program flops from XLA's own cost model
+            compiled = loop._train_step.lower(loop.state, dev_b).compile()
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            flops = float(ca.get("flops", 0.0))
+            if flops > 0:
+                mfu = flops / step_s / V5E_BF16_PEAK_FLOPS
+        except Exception:
+            pass
+        step_model_flops = _vgg_train_flops_per_image(
+            m["depth"], m["width"], sc["w"]) * batch
+        mfu_model = step_model_flops / step_s / V5E_BF16_PEAK_FLOPS
 
     t0 = time.monotonic()
     blob = model.dump_parameters()
@@ -566,7 +630,10 @@ def run_micro(sc: dict, detail: dict) -> None:
         "params_dump_s": round(dump_s, 3),
         "params_blob_mb": round(len(blob) / 1e6, 1),
         "mfu_vs_v5e_bf16_peak": round(mfu, 4) if mfu is not None else None,
-        "mfu_basis": "XLA whole-program flops — overstates vs model-flops MFU",
+        "mfu_model_flops": round(mfu_model, 4) if mfu_model is not None else None,
+        "mfu_basis": ("mfu_vs_v5e_bf16_peak: XLA whole-program flops — "
+                      "overstates vs model-flops MFU; mfu_model_flops: "
+                      "analytic conv+dense fwd+bwd; both null off-TPU"),
         "canonical_compute_s": round(
             sc["canon_train"] / train_img_s + sc["canon_eval"] / eval_img_s, 2),
     })

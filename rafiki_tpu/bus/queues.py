@@ -2,12 +2,21 @@
 
 Interface (mirrors the reference's Cache verbs, SURVEY.md §2):
   add_worker(job_id, worker_id)          — register a live worker
-  get_workers(job_id)                    — running-worker set
+  get_workers(job_id, max_age_s=None)    — running-worker set
   remove_worker(job_id, worker_id)
+  heartbeat(job_id, worker_id)           — refresh the liveness lease
   add_query(worker_id, query_id, query)  — predictor → worker fan-out
   pop_queries(worker_id, max_n, timeout) — worker batch pull
   put_prediction(query_id, worker_id, prediction)
   get_predictions(query_id, n, timeout)  — predictor gather-wait
+
+Liveness: registration is a LEASE, not a fact. A SIGKILLed worker
+process never runs its ``remove_worker`` cleanup (the reference has
+the same hole: its Redis running-worker set outlives the container),
+so each worker refreshes a heartbeat timestamp from a tiny daemon
+thread and readers pass ``max_age_s`` to see only workers whose lease
+is fresh — the predictor stops fanning out to (and waiting on) a dead
+worker within one lease TTL.
 """
 
 from __future__ import annotations
@@ -32,6 +41,7 @@ class InProcBus:
         self._preds: Dict[str, list] = {}
         self._pred_cv = threading.Condition()
         self._workers: Dict[str, set] = defaultdict(set)
+        self._worker_ts: Dict[Tuple[str, str], float] = {}
         self._expired: "deque[str]" = deque(maxlen=self._EXPIRED_CAP)
         self._expired_set: set = set()
         self._lock = threading.Lock()
@@ -41,16 +51,29 @@ class InProcBus:
     def add_worker(self, job_id: str, worker_id: str) -> None:
         with self._lock:
             self._workers[job_id].add(worker_id)
+            self._worker_ts[(job_id, worker_id)] = time.monotonic()
             self._queues.setdefault(worker_id, queue.Queue())
 
     def remove_worker(self, job_id: str, worker_id: str) -> None:
         with self._lock:
             self._workers[job_id].discard(worker_id)
+            self._worker_ts.pop((job_id, worker_id), None)
             self._queues.pop(worker_id, None)
 
-    def get_workers(self, job_id: str) -> List[str]:
+    def heartbeat(self, job_id: str, worker_id: str) -> None:
         with self._lock:
-            return sorted(self._workers[job_id])
+            if worker_id in self._workers[job_id]:  # never resurrect
+                self._worker_ts[(job_id, worker_id)] = time.monotonic()
+
+    def get_workers(self, job_id: str,
+                    max_age_s: Optional[float] = None) -> List[str]:
+        with self._lock:
+            ws = self._workers[job_id]
+            if max_age_s is None:
+                return sorted(ws)
+            cutoff = time.monotonic() - max_age_s
+            return sorted(w for w in ws
+                          if self._worker_ts.get((job_id, w), 0.0) >= cutoff)
 
     # -- queries -------------------------------------------------------------
 
@@ -123,82 +146,103 @@ def make_mp_bus(manager=None):
 
 
 class _MpBus:
+    """Cross-process bus over Manager dict/Lock proxies ONLY.
+
+    Every shared structure is a manager.dict holding PLAIN values
+    updated copy-on-write (read, rebuild, reassign under the lock) —
+    no nested proxies and no manager handle needed after construction,
+    so the bus object itself pickles into spawn children (the Manager
+    object does not pickle; nested list/Queue proxies would force
+    children to create new shared objects through it). Manager ops are
+    IPC round-trips either way, so polling every 5ms instead of
+    blocking Queue.get costs nothing extra at this bus's scale.
+    """
+
     def __init__(self, manager):
-        self._manager = manager
-        self._queues = manager.dict()   # worker_id -> manager.Queue
-        self._preds = manager.dict()    # query_id -> manager.list
-        self._workers = manager.dict()  # job_id -> manager.list
+        self._manager = manager         # keepalive only; dropped on pickle
+        self._queues = manager.dict()   # worker_id -> tuple of (qid, query)
+        self._preds = manager.dict()    # query_id -> tuple of (worker, pred)
+        self._workers = manager.dict()  # job_id -> tuple of worker ids
+        self._worker_ts = manager.dict()  # "job|worker" -> epoch seconds
         self._expired = manager.dict()  # gathered/timed-out query ids
         self._lock = manager.Lock()
 
-    def _q(self, worker_id: str):
-        with self._lock:
-            q = self._queues.get(worker_id)
-            if q is None:
-                q = self._manager.Queue()
-                self._queues[worker_id] = q
-        return q
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_manager"] = None  # children use proxies, never the manager
+        return state
 
     def add_worker(self, job_id, worker_id):
         with self._lock:
-            ws = self._workers.get(job_id)
-            if ws is None:
-                ws = self._manager.list()
-                self._workers[job_id] = ws
-            if worker_id not in list(ws):
-                ws.append(worker_id)
+            ws = self._workers.get(job_id, ())
+            if worker_id not in ws:
+                self._workers[job_id] = ws + (worker_id,)
+            self._queues.setdefault(worker_id, ())
+            # time.time(), not monotonic: leases are compared across
+            # processes and wall clock is the shared clock here.
+            self._worker_ts[f"{job_id}|{worker_id}"] = time.time()
 
     def remove_worker(self, job_id, worker_id):
         with self._lock:
-            ws = self._workers.get(job_id)
-            if ws is not None and worker_id in list(ws):
-                ws.remove(worker_id)
+            ws = self._workers.get(job_id, ())
+            if worker_id in ws:
+                self._workers[job_id] = tuple(w for w in ws if w != worker_id)
+            self._worker_ts.pop(f"{job_id}|{worker_id}", None)
+            self._queues.pop(worker_id, None)
 
-    def get_workers(self, job_id):
-        ws = self._workers.get(job_id)
-        return sorted(list(ws)) if ws is not None else []
+    def heartbeat(self, job_id, worker_id):
+        with self._lock:
+            if worker_id in self._workers.get(job_id, ()):  # never resurrect
+                self._worker_ts[f"{job_id}|{worker_id}"] = time.time()
+
+    def get_workers(self, job_id, max_age_s=None):
+        ws = self._workers.get(job_id, ())
+        if max_age_s is None:
+            return sorted(ws)
+        cutoff = time.time() - max_age_s
+        ts = dict(self._worker_ts)
+        return sorted(w for w in ws
+                      if ts.get(f"{job_id}|{w}", 0.0) >= cutoff)
 
     def add_query(self, worker_id, query_id, query):
-        self._q(worker_id).put((query_id, query))
+        with self._lock:
+            pending = self._queues.get(worker_id)
+            if pending is None:  # dead worker → drop; gather sees n-1
+                return
+            self._queues[worker_id] = pending + ((query_id, query),)
 
     def pop_queries(self, worker_id, max_n=64, timeout=0.1):
-        import queue as q_mod
-
-        q = self._q(worker_id)
-        out = []
-        try:
-            out.append(q.get(timeout=timeout))
-        except q_mod.Empty:
-            return out
-        while len(out) < max_n:
-            try:
-                out.append(q.get_nowait())
-            except q_mod.Empty:
-                break
-        return out
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                pending = self._queues.get(worker_id)
+                if pending:
+                    self._queues[worker_id] = pending[max_n:]
+                    return list(pending[:max_n])
+            if pending is None:  # not registered (stopped)
+                time.sleep(min(timeout, 0.05))
+                return []
+            if time.monotonic() >= deadline:
+                return []
+            time.sleep(0.005)
 
     def put_prediction(self, query_id, worker_id, prediction):
         with self._lock:
             if query_id in self._expired:
                 return  # late answer to a timed-out query: drop, don't leak
-            preds = self._preds.get(query_id)
-            if preds is None:
-                preds = self._manager.list()
-                self._preds[query_id] = preds
-            preds.append((worker_id, prediction))
+            self._preds[query_id] = (self._preds.get(query_id, ())
+                                     + ((worker_id, prediction),))
 
     def get_predictions(self, query_id, n, timeout=10.0):
         deadline = time.monotonic() + timeout
         while True:
-            preds = self._preds.get(query_id)
-            if preds is not None and len(preds) >= n:
-                break
-            if time.monotonic() >= deadline:
+            preds = self._preds.get(query_id, ())
+            if len(preds) >= n or time.monotonic() >= deadline:
                 break
             time.sleep(0.005)
         with self._lock:
-            preds = self._preds.pop(query_id, None)
+            preds = self._preds.pop(query_id, ())
             self._expired[query_id] = True
             if len(self._expired) > 4096:
                 self._expired.clear()  # coarse cap; stale ids just re-leak one slot
-        return list(preds) if preds is not None else []
+        return list(preds)

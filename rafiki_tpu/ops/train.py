@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import inspect
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Hashable, Iterable, Optional, Tuple
 
@@ -282,7 +283,10 @@ _PROGRAM_CACHE_CAP = 64
 
 _programs: "Dict[Hashable, Program]" = {}  # insertion-ordered → LRU via re-insert
 _build_locks: Dict[Hashable, threading.Lock] = {}
-_stats = {"hits": 0, "misses": 0, "evictions": 0}
+# last_miss_ts (epoch seconds, comparable to the meta store's trial
+# timestamps) lets the bench separate trials that ran entirely after
+# the final cold compile — the honest steady-state population.
+_stats = {"hits": 0, "misses": 0, "evictions": 0, "last_miss_ts": 0.0}
 _guard = threading.Lock()
 
 
@@ -330,6 +334,7 @@ def get_program(key: Hashable, builder: Callable[[], Program]) -> Program:
             # install a fresh lock and build a duplicate.
             _programs[key] = prog
             _stats["misses"] += 1
+            _stats["last_miss_ts"] = time.time()
             _build_locks.pop(key, None)
             while len(_programs) > _PROGRAM_CACHE_CAP:
                 _programs.pop(next(iter(_programs)))
@@ -346,7 +351,7 @@ def clear_program_cache() -> None:
     with _guard:
         _programs.clear()
         _build_locks.clear()
-        _stats.update(hits=0, misses=0, evictions=0)
+        _stats.update(hits=0, misses=0, evictions=0, last_miss_ts=0.0)
 
 
 # ---------------------------------------------------------------------------

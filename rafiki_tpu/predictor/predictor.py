@@ -16,14 +16,30 @@ from rafiki_tpu.predictor.ensemble import ensemble_predictions
 
 
 class Predictor:
-    def __init__(self, bus, job_id: str, timeout_s: float = 10.0):
+    def __init__(self, bus, job_id: str, timeout_s: float = 10.0,
+                 worker_ttl_s: float = 3.0):
         self.bus = bus
         self.job_id = job_id
         self.timeout_s = timeout_s
+        # Liveness lease TTL: workers heartbeat every ~0.5s from a
+        # dedicated thread (worker/inference.py), so a worker missing
+        # for worker_ttl_s is dead (SIGKILL never runs remove_worker).
+        # Must comfortably exceed the heartbeat period, not predict
+        # latency — the lease stays fresh through a long forward.
+        self.worker_ttl_s = worker_ttl_s
 
     def predict(self, queries: List[Any]) -> List[Any]:
-        """Fan each query out to all live workers; ensemble per query."""
-        workers = self.bus.get_workers(self.job_id)
+        """Fan each query out to all fresh-leased workers; ensemble per
+        query. A dead-but-registered worker stops being fanned out to
+        (and waited on) within one lease TTL — the ensemble degrades to
+        k-1 instead of every batch paying the full gather timeout."""
+        workers = self.bus.get_workers(self.job_id,
+                                       max_age_s=self.worker_ttl_s)
+        if not workers:
+            # Stale leases but live registrations: fall back to the
+            # registry rather than failing — a paused/starved host must
+            # degrade to slow answers, not a hard outage.
+            workers = self.bus.get_workers(self.job_id)
         if not workers:
             raise RuntimeError(f"No live inference workers for job {self.job_id}")
         qids = []

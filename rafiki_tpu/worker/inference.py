@@ -30,11 +30,28 @@ class InferenceWorker:
         self.batch_size = batch_size
         self._stop = stop_event or threading.Event()
 
+    HEARTBEAT_S = 0.5
+
     def stop(self) -> None:
         self._stop.set()
 
+    def _beat(self) -> None:
+        """Liveness lease refresher. A separate daemon thread, not the
+        serve loop: model.predict can hold the loop for seconds (first
+        forward pays the XLA compile) and the lease must stay fresh
+        through it. XLA/numpy release the GIL, so this thread runs even
+        mid-forward; SIGKILL stops it with the process — which is
+        exactly the signal the predictor's max_age_s filter consumes."""
+        while not self._stop.wait(self.HEARTBEAT_S):
+            try:
+                self.bus.heartbeat(self.job_id, self.worker_id)
+            except Exception:  # manager teardown mid-beat: exit quietly
+                return
+
     def run(self) -> None:
         self.bus.add_worker(self.job_id, self.worker_id)
+        threading.Thread(target=self._beat, name=f"beat-{self.worker_id}",
+                         daemon=True).start()
         try:
             while not self._stop.is_set():
                 items = self.bus.pop_queries(self.worker_id, max_n=self.batch_size,
@@ -58,3 +75,28 @@ class InferenceWorker:
         # already batches the device forward internally, so the whole
         # popped micro-batch still runs as one XLA program.
         return self.model.predict(queries)
+
+
+def run_inference_worker_process(bus, meta_path: str, params_path: str,
+                                 trial_id: str, job_id: str, worker_id: str,
+                                 batch_size: int = 64) -> None:
+    """Entrypoint for an inference worker as its OWN process (spawn
+    target; the mp-bus proxies pickle across). Rebuilds the trial's
+    model from the store — class bytes + knobs + trained params — then
+    serves until killed. This is the deployment shape the reference
+    gets from one-container-per-trial (SURVEY.md §3.2), and the unit
+    the serve-path elasticity test SIGKILLs."""
+    from rafiki_tpu.model.base import load_model_class
+    from rafiki_tpu.store import MetaStore, ParamsStore
+
+    store = MetaStore(meta_path)
+    params_store = ParamsStore(params_path)
+    trial = store.get_trial(trial_id)
+    sub = store.get_sub_train_job(trial["sub_train_job_id"])
+    model_row = store.get_model(sub["model_id"])
+    cls = load_model_class(model_row["model_file"], model_row["model_class"])
+    model = cls(**trial["knobs"])
+    if trial.get("params_id"):
+        model.load_parameters(params_store.load(trial["params_id"]))
+    InferenceWorker(bus, job_id, worker_id, model,
+                    batch_size=batch_size).run()

@@ -1,0 +1,118 @@
+"""Serve-path elasticity: the predictor must survive inference-worker
+death mid-serving, matching the train path's SIGKILL coverage
+(tests/test_elastic.py).
+
+The serving unit killed here is a real OS process — the deployment
+shape the reference gets from one-container-per-trial (SURVEY.md
+§3.2) — running ``run_inference_worker_process`` over the mp bus.
+SIGKILL means the worker's ``remove_worker`` cleanup never runs, so
+its bus registration outlives it; liveness is the heartbeat lease
+(bus/queues.py): the predictor stops fanning out to (and waiting on)
+the corpse within one lease TTL and the ensemble degrades to k-1.
+"""
+
+import multiprocessing as mp
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.bus import make_mp_bus
+from rafiki_tpu.predictor.predictor import Predictor
+from rafiki_tpu.scheduler import LocalScheduler
+from rafiki_tpu.store import MetaStore, ParamsStore
+from rafiki_tpu.worker.inference import run_inference_worker_process
+
+from tests.test_scheduler import FF_SOURCE, TRAIN, VAL
+
+JOB = "serve-elastic"
+TIMEOUT_S = 3.0   # predictor batch gather deadline
+TTL_S = 2.0       # liveness lease; heartbeats refresh every 0.5s
+
+
+def _ok(out):
+    return all(not (isinstance(o, dict) and "error" in o) for o in out)
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """Two trained trials served by two real worker processes."""
+    tmp = tmp_path_factory.mktemp("serve")
+    store = MetaStore(tmp / "meta.sqlite3")
+    params = ParamsStore(tmp / "params")
+    model = store.create_model("tinyff", "IMAGE_CLASSIFICATION", None,
+                               FF_SOURCE, "TinyFF")
+    job = store.create_train_job("app", "IMAGE_CLASSIFICATION", None,
+                                 TRAIN, VAL, {"MODEL_TRIAL_COUNT": 2})
+    store.create_sub_train_job(job["id"], model["id"])
+    result = LocalScheduler(store, params).run_train_job(
+        job["id"], n_workers=1, advisor_kind="random")
+    best = result.best_trials[:2]
+    assert len(best) == 2
+
+    ctx = mp.get_context("spawn")
+    bus = make_mp_bus(ctx.Manager())
+    procs = [
+        ctx.Process(
+            target=run_inference_worker_process,
+            args=(bus, str(tmp / "meta.sqlite3"), str(tmp / "params"),
+                  t["id"], JOB, f"iw-{i}"),
+            daemon=True)
+        for i, t in enumerate(best)
+    ]
+    for p in procs:
+        p.start()
+    deadline = time.monotonic() + 120
+    while len(bus.get_workers(JOB)) < 2:
+        assert time.monotonic() < deadline, "workers never registered"
+        time.sleep(0.05)
+    yield bus, procs
+    for p in procs:
+        if p.is_alive():
+            p.kill()
+
+
+def test_sigkilled_inference_worker_degrades_to_k_minus_1(served):
+    bus, procs = served
+    pred = Predictor(bus, JOB, timeout_s=TIMEOUT_S, worker_ttl_s=TTL_S)
+    rng = np.random.default_rng(0)
+    queries = list(rng.uniform(0, 1, size=(8, 8, 8, 3)).astype(np.float32))
+
+    # Warm until BOTH workers answer within the deadline (first forward
+    # pays each subprocess's XLA compile).
+    deadline = time.monotonic() + 120
+    while not _ok(pred.predict(queries)):
+        assert time.monotonic() < deadline, "serving never warmed"
+        time.sleep(0.5)
+
+    # SIGKILL one worker mid-serving: no cleanup, registration leaks.
+    os.kill(procs[0].pid, signal.SIGKILL)
+    procs[0].join(10)
+    assert not procs[0].is_alive()
+
+    # The very next batch must still answer (k-1 ensemble), bounded by
+    # ONE batch deadline — the corpse costs at most timeout_s once.
+    t0 = time.monotonic()
+    out = pred.predict(queries)
+    dt = time.monotonic() - t0
+    assert _ok(out), f"post-kill batch failed: {out[:2]}"
+    assert dt < TIMEOUT_S + 2.0, f"post-kill batch took {dt:.1f}s"
+
+    # Once the lease expires the corpse is dropped from fan-out
+    # entirely: batches stop paying the gather timeout at all.
+    time.sleep(TTL_S + 1.0)
+    assert bus.get_workers(JOB, max_age_s=TTL_S) == ["iw-1"], \
+        "dead worker still holds a fresh lease"
+    t0 = time.monotonic()
+    out = pred.predict(queries)
+    dt = time.monotonic() - t0
+    assert _ok(out)
+    assert dt < TIMEOUT_S, \
+        f"lease-expired corpse still stalls the gather ({dt:.1f}s)"
+
+    # The survivor keeps serving at full quality: responses are prob
+    # vectors over the 5 synthetic classes.
+    assert len(out) == len(queries)
+    assert all(len(np.asarray(o)) == 5 for o in out)
