@@ -46,7 +46,12 @@ POST-GP-fit (>=30 observations), a GP-vs-random ``advisor_lift`` over
 >=3 seeds with its dispersion, params dump time, program/compile-cache
 statistics, and acceptance config 5 served BOTH ways: the
 reference-shaped one-worker-per-trial ensemble and ServicesManager's
-stacked top-k path (one vmapped XLA program).
+stacked top-k path (one vmapped XLA program). The artifact also embeds
+``detail.telemetry`` — the unified telemetry snapshot
+(rafiki_tpu/telemetry/): per-phase trial spans (advisor-propose /
+build / train / evaluate / persist), program-cache hit/miss/eviction,
+host-feed vs step time, and serving-path counters — so every headline
+number decomposes into attributable spans.
 
 vs_baseline: the 120 trials/hour/GPU denominator is an ESTIMATE
 (BASELINE.md §Baseline derivation: V100 mixed-precision VGG16
@@ -680,9 +685,19 @@ def main() -> None:
         if os.environ.get("RAFIKI_BENCH_TOP1_TARGET"):  # tests force the red path
             sc["top1_target"] = float(os.environ["RAFIKI_BENCH_TOP1_TARGET"])
         detail["n_trials_requested"] = sc["trials"]
+        from rafiki_tpu import telemetry
+
         run_real_loop(sc, detail)  # first: its compiles must be COLD
+        # Embed the span/metric snapshot NOW, while it holds exactly the
+        # headline job's trials — per-phase spans (advisor-propose /
+        # build / train / evaluate / persist), program-cache hit/miss,
+        # host-feed vs step time — so the BENCH artifact decomposes its
+        # own wall-clock. Refreshed after the remaining sections so the
+        # final artifact also covers serving/micro/lift activity.
+        detail["telemetry"] = telemetry.snapshot()
         run_micro(sc, detail)
         run_advisor_lift(sc, detail)
+        detail["telemetry"] = telemetry.snapshot()
         if detail.get("top1_miss"):
             # The accuracy clause is a GATE, not a footnote: a learning
             # regression (or an advisor steering into bad regions) must

@@ -32,6 +32,7 @@ Route table (mirrors the reference's client verbs):
                                      (for process-per-chip workers)
   GET  /                             web admin UI (static SPA)
   GET  /healthz                      liveness
+  GET  /metrics                      telemetry snapshot (read-only JSON)
 """
 
 from __future__ import annotations
@@ -64,6 +65,7 @@ class AdminApp:
         self.url_map = Map([
             Rule("/", endpoint="web_index", methods=["GET"]),
             Rule("/healthz", endpoint="healthz", methods=["GET"]),
+            Rule("/metrics", endpoint="metrics", methods=["GET"]),
             Rule("/tokens", endpoint="login", methods=["POST"]),
             Rule("/users", endpoint="create_user", methods=["POST"]),
             Rule("/users", endpoint="get_users", methods=["GET"]),
@@ -174,6 +176,14 @@ class AdminApp:
 
     def ep_healthz(self, request: Request) -> Response:
         return _json({"status": "ok"})
+
+    def ep_metrics(self, request: Request) -> Response:
+        # Read-only process introspection, unauthenticated like
+        # /healthz: the snapshot carries timings and counts, never
+        # trial data or credentials.
+        from rafiki_tpu import telemetry
+
+        return _json(telemetry.snapshot())
 
     def ep_web_index(self, request: Request) -> Response:
         index = _WEB_DIR / "index.html"

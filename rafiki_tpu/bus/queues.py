@@ -16,7 +16,10 @@ the same hole: its Redis running-worker set outlives the container),
 so each worker refreshes a heartbeat timestamp from a tiny daemon
 thread and readers pass ``max_age_s`` to see only workers whose lease
 is fresh — the predictor stops fanning out to (and waiting on) a dead
-worker within one lease TTL.
+worker within one lease TTL. ``reap_stale(max_age_s)`` is the janitor
+half: once a lease is several TTLs old the corpse's registration,
+timestamp and pending-query queue are deleted outright (counted in
+telemetry as ``bus.reaped_workers``), so dead ids stop accumulating.
 """
 
 from __future__ import annotations
@@ -24,8 +27,11 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Tuple
+
+from collections import deque
+
+from rafiki_tpu import telemetry
 
 
 class InProcBus:
@@ -40,7 +46,11 @@ class InProcBus:
         self._queues: Dict[str, queue.Queue] = {}
         self._preds: Dict[str, list] = {}
         self._pred_cv = threading.Condition()
-        self._workers: Dict[str, set] = defaultdict(set)
+        # Plain dict, NOT defaultdict: read paths (heartbeat of a
+        # removed worker, get_workers of a finished job) used to
+        # materialize an empty set per probed job id — a slow leak
+        # under repeated job cycles.
+        self._workers: Dict[str, set] = {}
         self._worker_ts: Dict[Tuple[str, str], float] = {}
         self._expired: "deque[str]" = deque(maxlen=self._EXPIRED_CAP)
         self._expired_set: set = set()
@@ -50,38 +60,69 @@ class InProcBus:
 
     def add_worker(self, job_id: str, worker_id: str) -> None:
         with self._lock:
-            self._workers[job_id].add(worker_id)
+            self._workers.setdefault(job_id, set()).add(worker_id)
             self._worker_ts[(job_id, worker_id)] = time.monotonic()
             self._queues.setdefault(worker_id, queue.Queue())
 
     def remove_worker(self, job_id: str, worker_id: str) -> None:
         with self._lock:
-            self._workers[job_id].discard(worker_id)
+            self._workers.get(job_id, set()).discard(worker_id)
             self._worker_ts.pop((job_id, worker_id), None)
             self._queues.pop(worker_id, None)
 
     def heartbeat(self, job_id: str, worker_id: str) -> None:
         with self._lock:
-            if worker_id in self._workers[job_id]:  # never resurrect
+            if worker_id in self._workers.get(job_id, ()):  # never resurrect
                 self._worker_ts[(job_id, worker_id)] = time.monotonic()
 
     def get_workers(self, job_id: str,
                     max_age_s: Optional[float] = None) -> List[str]:
         with self._lock:
-            ws = self._workers[job_id]
+            ws = self._workers.get(job_id, ())
             if max_age_s is None:
                 return sorted(ws)
             cutoff = time.monotonic() - max_age_s
             return sorted(w for w in ws
                           if self._worker_ts.get((job_id, w), 0.0) >= cutoff)
 
+    def reap_stale(self, max_age_s: float,
+                   job_id: Optional[str] = None) -> List[Tuple[str, str]]:
+        """Janitor: delete every registration whose lease is older than
+        ``max_age_s`` — worker set entry, timestamp AND pending-query
+        queue, so a SIGKILLed worker's leftovers stop accumulating.
+        Callers pick max_age_s well above the liveness TTL (the
+        predictor uses k×TTL): reaping is for corpses, not for workers
+        a busy host merely starved for one beat."""
+        cutoff = time.monotonic() - max_age_s
+        reaped: List[Tuple[str, str]] = []
+        with self._lock:
+            jobs = [job_id] if job_id is not None else list(self._workers)
+            for j in jobs:
+                ws = self._workers.get(j)
+                if not ws:
+                    continue
+                for w in [w for w in ws
+                          if self._worker_ts.get((j, w), 0.0) < cutoff]:
+                    ws.discard(w)
+                    self._worker_ts.pop((j, w), None)
+                    self._queues.pop(w, None)
+                    reaped.append((j, w))
+        if reaped:
+            telemetry.inc("bus.reaped_workers", len(reaped))
+        return reaped
+
     # -- queries -------------------------------------------------------------
 
     def add_query(self, worker_id: str, query_id: str, query: Any) -> None:
         with self._lock:
             q = self._queues.get(worker_id)
+            depth = sum(qq.qsize() for qq in self._queues.values())
         if q is not None:  # dead worker → drop; the gather just sees n-1
             q.put((query_id, query))
+            telemetry.inc("bus.queries_added")
+            telemetry.set_gauge("bus.queue_depth", depth + 1)
+        else:
+            telemetry.inc("bus.queries_dropped_dead_worker")
 
     def pop_queries(self, worker_id: str, max_n: int = 64,
                     timeout: float = 0.1) -> List[Tuple[str, Any]]:
@@ -102,6 +143,8 @@ class InProcBus:
                 out.append(q.get_nowait())
             except queue.Empty:
                 break
+        telemetry.inc("bus.queries_popped", len(out))
+        telemetry.observe("bus.pop_batch_size", len(out))
         return out
 
     # -- predictions ---------------------------------------------------------
@@ -203,6 +246,31 @@ class _MpBus:
         ts = dict(self._worker_ts)
         return sorted(w for w in ws
                       if ts.get(f"{job_id}|{w}", 0.0) >= cutoff)
+
+    def reap_stale(self, max_age_s, job_id=None):
+        """Same janitor contract as InProcBus.reap_stale, over the
+        manager proxies (copy-on-write tuple rebuild under the lock).
+        The reap counter is per-process — whichever process runs the
+        janitor (normally the predictor's) observes the reaps."""
+        cutoff = time.time() - max_age_s
+        reaped = []
+        with self._lock:
+            jobs = [job_id] if job_id is not None else list(self._workers.keys())
+            ts = dict(self._worker_ts)
+            for j in jobs:
+                ws = self._workers.get(j, ())
+                dead = tuple(w for w in ws
+                             if ts.get(f"{j}|{w}", 0.0) < cutoff)
+                if not dead:
+                    continue
+                self._workers[j] = tuple(w for w in ws if w not in dead)
+                for w in dead:
+                    self._worker_ts.pop(f"{j}|{w}", None)
+                    self._queues.pop(w, None)
+                    reaped.append((j, w))
+        if reaped:
+            telemetry.inc("bus.reaped_workers", len(reaped))
+        return reaped
 
     def add_query(self, worker_id, query_id, query):
         with self._lock:

@@ -39,6 +39,8 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from rafiki_tpu import telemetry
+
 Batch = Dict[str, np.ndarray]
 Params = Any
 # Canonical loss signature: (params, batch, rng, hyper) -> (loss, metrics).
@@ -311,6 +313,7 @@ def get_program(key: Hashable, builder: Callable[[], Program]) -> Program:
         if prog is not None:
             _programs[key] = _programs.pop(key)  # refresh LRU position
             _stats["hits"] += 1
+            telemetry.inc("program_cache.hits")
             return prog
         lock = _build_locks.setdefault(key, threading.Lock())
     with lock:
@@ -318,9 +321,11 @@ def get_program(key: Hashable, builder: Callable[[], Program]) -> Program:
             prog = _programs.get(key)
             if prog is not None:
                 _stats["hits"] += 1
+                telemetry.inc("program_cache.hits")
                 return prog
         try:
-            prog = builder()
+            with telemetry.span("program.build"):
+                prog = builder()
         except BaseException:
             # Drop the build lock entry when the builder raises (e.g. a
             # knob combo whose trace fails) — _build_locks must not
@@ -336,15 +341,27 @@ def get_program(key: Hashable, builder: Callable[[], Program]) -> Program:
             _stats["misses"] += 1
             _stats["last_miss_ts"] = time.time()
             _build_locks.pop(key, None)
+            evicted = 0
             while len(_programs) > _PROGRAM_CACHE_CAP:
                 _programs.pop(next(iter(_programs)))
                 _stats["evictions"] += 1
+                evicted += 1
+        telemetry.inc("program_cache.misses")
+        if evicted:
+            telemetry.inc("program_cache.evictions", evicted)
     return prog
 
 
 def program_cache_stats() -> Dict[str, int]:
     with _guard:
         return dict(_stats, size=len(_programs))
+
+
+# The cache's lifetime stats surface through the telemetry registry
+# too: /metrics and BENCH snapshots see hit/miss/eviction/size without
+# a second bookkeeping path (the counters above cover deltas; this
+# collector is the authoritative absolute view, reset-proof).
+telemetry.register_collector("program_cache", program_cache_stats)
 
 
 def clear_program_cache() -> None:
@@ -482,6 +499,7 @@ class TrainLoop:
             raise ValueError(
                 f"Dataset has {dataset.size} examples < batch_size={batch_size}; "
                 f"the epoch would run zero steps")
+        t_epoch = time.monotonic()
         if on_metrics is None and self._fits_device_fast_path(dataset):
             X, Y = get_device_dataset(dataset)
             n_steps = dataset.size // batch_size
@@ -489,19 +507,39 @@ class TrainLoop:
             idx = perm[: n_steps * batch_size].reshape(
                 n_steps, batch_size).astype(np.int32)
             self.state, metrics = self.program.train_epoch(self.state, X, Y, idx)
-            return {k: float(v) for k, v in metrics.items()}
+            out = {k: float(v) for k, v in metrics.items()}
+            self._record_epoch(t_epoch, feed_s=0.0)
+            return out
         count = 0
         metrics = None
+        feed_s = 0.0
         for i, batch in enumerate(dataset.batches(batch_size, shuffle=True, seed=epoch_seed,
                                                   drop_remainder=True)):
             batch.pop("valid", None)
+            t_feed = time.monotonic()
             dev_batch = self.plan.put_batch(batch)
+            feed_s += time.monotonic() - t_feed
             self.state, metrics = self._train_step(self.state, dev_batch)
             count += 1
             if on_metrics is not None and (i % 50 == 0):
                 on_metrics(i, {k: float(v) for k, v in metrics.items()})
         # Final-step metrics are the epoch result (one host sync per epoch).
-        return {k: float(v) for k, v in metrics.items()} if count else {}
+        out = {k: float(v) for k, v in metrics.items()} if count else {}
+        self._record_epoch(t_epoch, feed_s)
+        return out
+
+    def _record_epoch(self, t0: float, feed_s: float) -> None:
+        """Compile-vs-step-vs-feed attribution at epoch granularity: the
+        first epoch of a TrainLoop pays the XLA compile (or the program-
+        cache hit), so its wall-clock lands in a separate histogram
+        instead of polluting the steady-state distribution."""
+        dt = time.monotonic() - t0
+        cold = not getattr(self, "_warm", False)
+        self._warm = True
+        telemetry.observe("train.cold_epoch_s" if cold else "train.epoch_s", dt)
+        if feed_s > 0.0:
+            telemetry.inc("train.host_feed_s", feed_s)
+        telemetry.inc("train.step_s", max(dt - feed_s, 0.0))
 
     def evaluate(self, dataset, batch_size: int) -> float:
         total_correct = jnp.zeros((), jnp.int32)

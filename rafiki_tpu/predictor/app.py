@@ -20,7 +20,9 @@ from rafiki_tpu.utils.jsonable import jsonable as _jsonable
 
 
 class PredictorApp:
-    """WSGI app: POST /predict {"queries": [...]}, GET /healthz."""
+    """WSGI app: POST /predict {"queries": [...]}, GET /healthz,
+    GET /metrics (read-only telemetry snapshot — spans, counters,
+    queue-depth gauges, gather-latency histograms of THIS process)."""
 
     def __init__(self, predictor: Predictor):
         self.predictor = predictor
@@ -30,6 +32,10 @@ class PredictorApp:
         try:
             if request.path == "/healthz" and request.method == "GET":
                 response = self._json({"status": "ok"})
+            elif request.path == "/metrics" and request.method == "GET":
+                from rafiki_tpu import telemetry
+
+                response = self._json(telemetry.snapshot())
             elif request.path == "/predict" and request.method == "POST":
                 body = request.get_json(force=True, silent=True) or {}
                 queries = body.get("queries")

@@ -4,6 +4,15 @@ timeout, ensemble.
 Reference parity: rafiki/predictor/predictor.py (unverified —
 SURVEY.md §3.2 call stack): per query, enqueue to every live worker of
 the job, await all predictions with a timeout, ensemble, respond.
+
+Liveness contract: fan-out goes ONLY to workers with a fresh heartbeat
+lease. When every lease is stale the batch fails fast with
+``RuntimeError("no live inference workers ...")`` — an outage must
+surface as an outage (503 at the HTTP layer, ``predictor.no_live_workers``
+in telemetry), not as per-query timeout errors that masquerade as slow
+answers. The predictor also runs the bus janitor each batch: leases
+older than ``REAP_TTL_FACTOR×TTL`` are corpses whose registrations get
+deleted outright.
 """
 
 from __future__ import annotations
@@ -12,10 +21,15 @@ import time
 import uuid
 from typing import Any, List, Optional
 
+from rafiki_tpu import telemetry
 from rafiki_tpu.predictor.ensemble import ensemble_predictions
 
 
 class Predictor:
+    # A lease this many TTLs old is a corpse, not a starved worker:
+    # reap its registration instead of filtering it forever.
+    REAP_TTL_FACTOR = 4.0
+
     def __init__(self, bus, job_id: str, timeout_s: float = 10.0,
                  worker_ttl_s: float = 3.0):
         self.bus = bus
@@ -33,15 +47,21 @@ class Predictor:
         query. A dead-but-registered worker stops being fanned out to
         (and waited on) within one lease TTL — the ensemble degrades to
         k-1 instead of every batch paying the full gather timeout."""
+        reap = getattr(self.bus, "reap_stale", None)
+        if reap is not None:
+            reap(self.REAP_TTL_FACTOR * self.worker_ttl_s, job_id=self.job_id)
         workers = self.bus.get_workers(self.job_id,
                                        max_age_s=self.worker_ttl_s)
         if not workers:
-            # Stale leases but live registrations: fall back to the
-            # registry rather than failing — a paused/starved host must
-            # degrade to slow answers, not a hard outage.
-            workers = self.bus.get_workers(self.job_id)
-        if not workers:
-            raise RuntimeError(f"No live inference workers for job {self.job_id}")
+            # Every lease is stale (or nothing registered): this job has
+            # no serving capacity RIGHT NOW. Fail the batch explicitly —
+            # fanning out to corpses would mask the outage as per-query
+            # timeout errors and stall every caller for timeout_s.
+            telemetry.inc("predictor.no_live_workers")
+            raise RuntimeError(
+                f"no live inference workers for job {self.job_id}")
+        telemetry.inc("predictor.queries", len(queries))
+        telemetry.observe("predictor.fanout_workers", len(workers))
         qids = []
         for query in queries:
             qid = uuid.uuid4().hex
@@ -54,13 +74,19 @@ class Predictor:
         # deadline, remaining queries gather non-blockingly (timeout 0)
         # so batch latency stays bounded by timeout_s regardless of
         # batch size.
-        deadline = time.monotonic() + self.timeout_s
+        t_gather = time.monotonic()
+        deadline = t_gather + self.timeout_s
         out: List[Any] = []
+        timeouts = 0
         for qid in qids:
             remaining = max(0.0, deadline - time.monotonic())
             preds = self.bus.get_predictions(qid, n=len(workers), timeout=remaining)
             if not preds:
+                timeouts += 1
                 out.append({"error": "prediction timeout"})
             else:
                 out.append(ensemble_predictions([p for _, p in preds]))
+        telemetry.observe("predictor.gather_s", time.monotonic() - t_gather)
+        if timeouts:
+            telemetry.inc("predictor.query_timeouts", timeouts)
         return out

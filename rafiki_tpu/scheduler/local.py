@@ -15,6 +15,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from rafiki_tpu import telemetry
 from rafiki_tpu.advisor import AdvisorService
 from rafiki_tpu.constants import ServiceStatus, ServiceType, TrainJobStatus, TrialStatus
 from rafiki_tpu.model.base import load_model_class
@@ -143,6 +144,8 @@ class LocalScheduler:
         else:
             status = TrainJobStatus.COMPLETED.value
         self.store.update_train_job_status(job_id, status)
+        telemetry.inc("scheduler.train_jobs_finished")
+        telemetry.observe("scheduler.train_job_s", time.time() - t0)
         events.emit("train_job_finished", job_id=job_id, status=status,
                     duration_s=round(time.time() - t0, 3))
         return TrainJobResult(
@@ -156,7 +159,10 @@ class LocalScheduler:
 
     @staticmethod
     def _run_worker(worker: TrainWorker, errors: List[str]) -> None:
+        telemetry.add_gauge("scheduler.active_workers", 1)
         try:
             worker.run()
         except Exception as e:  # worker crash ≠ job crash; trials already contained
             errors.append(f"worker {worker.worker_id}: {e!r}")
+        finally:
+            telemetry.add_gauge("scheduler.active_workers", -1)

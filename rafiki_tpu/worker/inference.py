@@ -17,6 +17,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from rafiki_tpu import telemetry
 from rafiki_tpu.model.base import BaseModel
 
 
@@ -61,8 +62,12 @@ class InferenceWorker:
                 qids = [qid for qid, _ in items]
                 queries = [q for _, q in items]
                 try:
-                    preds = self._predict(queries)
+                    with telemetry.span("inference.forward",
+                                        worker_id=self.worker_id):
+                        preds = self._predict(queries)
+                    telemetry.inc("inference.queries_served", len(queries))
                 except Exception as e:  # a bad query batch must not kill the worker
+                    telemetry.inc("inference.batch_errors")
                     preds = [{"error": str(e)}] * len(queries)
                 for qid, pred in zip(qids, preds):
                     self.bus.put_prediction(qid, self.worker_id, pred)
@@ -86,6 +91,15 @@ def run_inference_worker_process(bus, meta_path: str, params_path: str,
     serves until killed. This is the deployment shape the reference
     gets from one-container-per-trial (SURVEY.md §3.2), and the unit
     the serve-path elasticity test SIGKILLs."""
+    # FIRST, before anything touches jax: a spawned child re-imports
+    # everything fresh, and this image's sitecustomize force-registers
+    # the TPU backend regardless of JAX_PLATFORMS — when the tunnel is
+    # down the child then hangs in backend init and never registers on
+    # the bus (admin/app.py and worker/main.py already do this dance).
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()
+
     from rafiki_tpu.model.base import load_model_class
     from rafiki_tpu.store import MetaStore, ParamsStore
 

@@ -25,6 +25,7 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional, Protocol
 
+from rafiki_tpu import telemetry
 from rafiki_tpu.constants import BudgetType, TrainJobStatus, TrialStatus
 from rafiki_tpu.model.base import BaseModel, load_model_class
 from rafiki_tpu.model.knobs import Knobs, knob_config_signature
@@ -159,15 +160,21 @@ class TrainWorker:
         model: Optional[BaseModel] = None
         persisted_async = False
         try:
-            with logger.capture(sink), self._device_scope(), self._profile_scope(tid):
-                model = self.model_class(**knobs)
-                if self.devices is not None and len(self.devices) > 1 and hasattr(model, "set_mesh"):
-                    from rafiki_tpu.parallel.mesh import data_parallel_mesh
+            with telemetry.span("trial.total", trial_id=tid,
+                                worker_id=self.worker_id), \
+                    logger.capture(sink), self._device_scope(), \
+                    self._profile_scope(tid):
+                with telemetry.span("trial.build", trial_id=tid):
+                    model = self.model_class(**knobs)
+                    if self.devices is not None and len(self.devices) > 1 and hasattr(model, "set_mesh"):
+                        from rafiki_tpu.parallel.mesh import data_parallel_mesh
 
-                    model.set_mesh(data_parallel_mesh(self.devices))
-                self._wire_checkpoints(model, tid, resume)
-                model.train(self.train_uri)
-                score = float(model.evaluate(self.val_uri))
+                        model.set_mesh(data_parallel_mesh(self.devices))
+                    self._wire_checkpoints(model, tid, resume)
+                with telemetry.span("trial.train", trial_id=tid):
+                    model.train(self.train_uri)
+                with telemetry.span("trial.evaluate", trial_id=tid):
+                    score = float(model.evaluate(self.val_uri))
             # The advisor hears the score immediately (it steers the next
             # proposal); parameter persistence is NOT on the critical
             # path — the saver thread dumps/writes/marks-completed while
@@ -176,6 +183,7 @@ class TrainWorker:
             # serialize), so overlapping it nearly doubles short-trial
             # throughput.
             self.advisor.feedback(score, knobs)
+            telemetry.inc("worker.trials_succeeded")
             if self._saver is not None:
                 self._saver.submit(tid, model, score, sink)
                 persisted_async = True  # saver owns model.destroy() now
@@ -185,6 +193,7 @@ class TrainWorker:
             return self.store.get_trial(tid)
         except Exception:
             err = traceback.format_exc()
+            telemetry.inc("worker.trials_errored")
             self.store.mark_trial_as_errored(tid, err)
             events.emit("trial_errored", trial_id=tid, worker_id=self.worker_id,
                         error=err.splitlines()[-1] if err else "")
@@ -256,10 +265,11 @@ class TrainWorker:
         """Dump → write → mark completed (runs on the saver thread when
         async persistence is on)."""
         try:
-            blob = model.dump_parameters()
-            params_id = self.params_store.save(blob)
-            self.store.mark_trial_as_completed(tid, score, params_id)
-            self.params_store.delete_checkpoints(tid)  # superseded
+            with telemetry.span("trial.persist", trial_id=tid):
+                blob = model.dump_parameters()
+                params_id = self.params_store.save(blob)
+                self.store.mark_trial_as_completed(tid, score, params_id)
+                self.params_store.delete_checkpoints(tid)  # superseded
             events.emit("trial_completed", trial_id=tid, score=score,
                         worker_id=self.worker_id)
         except Exception:
@@ -325,7 +335,9 @@ class TrainWorker:
         budget_max = int(max_trials) if max_trials is not None else None
         try:
             while not self.budget_exhausted():
-                knobs = self.advisor.propose()
+                with telemetry.span("trial.advisor_propose",
+                                    worker_id=self.worker_id):
+                    knobs = self.advisor.propose()
                 # Slot-claim happens atomically inside the trial-row
                 # insert (crash between claim and insert cannot leak a
                 # budget slot); None back = budget drained, the unused

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# The tier-1 verify gate, verbatim from ROADMAP.md — builders, the TPU
+# watcher and CI must all run the IDENTICAL command so "tests pass"
+# means the same thing everywhere. Edit ROADMAP.md and this file
+# together or not at all.
+#
+# Prints DOTS_PASSED=<n> (count of passing-test dots) after the pytest
+# summary; exits with pytest's own return code.
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

@@ -76,16 +76,32 @@ def _run(label: str, cmd: list[str], timeout_s: float) -> tuple[int, str, str]:
 
 def capture() -> bool:
     """Green window: bench first (the headline artifact), calibration
-    second (tunnel may drop mid-window), then commit what we got."""
+    second (tunnel may drop mid-window), then commit what we got.
+
+    The bench output only counts as a headline when rc==0 AND its last
+    line parses as headline JSON — a crashed/killed bench whose stdout
+    happens to contain a '{' line must not be committed as evidence.
+    On an unusable run the watcher logs it and keeps probing (returns
+    False) instead of dying on a JSONDecodeError."""
     rc, out, _ = _run("bench", [sys.executable, "bench.py"], timeout_s=2100)
     got_bench = False
-    lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
-    if lines:
+    lines = out.strip().splitlines()
+    headline = None
+    if rc == 0 and lines:
+        try:
+            headline = json.loads(lines[-1])
+        except (json.JSONDecodeError, ValueError):
+            headline = None
+    if isinstance(headline, dict):
+        headline["rc"] = rc  # provenance: the exit code travels with the artifact
         with open(BENCH_OUT, "w") as f:
-            f.write(lines[-1] + "\n")
+            f.write(json.dumps(headline) + "\n")
         got_bench = True
         _log({"event": "bench_saved", "rc": rc,
-              "headline": json.loads(lines[-1]).get("value")})
+              "headline": headline.get("value")})
+    else:
+        _log({"event": "bench_unusable", "rc": rc,
+              "tail": lines[-1][-200:] if lines else ""})
 
     rc2, out2, _ = _run("calibration",
                         [sys.executable, "scripts/calibrate_bench_task.py",

@@ -29,7 +29,11 @@ from tests.test_scheduler import FF_SOURCE, TRAIN, VAL
 
 JOB = "serve-elastic"
 TIMEOUT_S = 3.0   # predictor batch gather deadline
-TTL_S = 2.0       # liveness lease; heartbeats refresh every 0.5s
+# Liveness lease: 6x the 0.5s heartbeat period, so a couple of missed
+# beats on a loaded CI host can't expire a LIVE worker's lease (the
+# old 4x margin flaked under manager-proxy latency spikes).
+TTL_S = 3.0
+HEARTBEAT_S = 0.5  # must match InferenceWorker.HEARTBEAT_S
 
 
 def _ok(out):
@@ -78,7 +82,10 @@ def test_sigkilled_inference_worker_degrades_to_k_minus_1(served):
     bus, procs = served
     pred = Predictor(bus, JOB, timeout_s=TIMEOUT_S, worker_ttl_s=TTL_S)
     rng = np.random.default_rng(0)
-    queries = list(rng.uniform(0, 1, size=(8, 8, 8, 3)).astype(np.float32))
+    # Shape must match TRAIN: synthetic images default to c=1, so the
+    # trained MLP flattens 8*8*1=64 features — 3-channel queries would
+    # shape-error in every worker and the warm loop could never pass.
+    queries = list(rng.uniform(0, 1, size=(8, 8, 8, 1)).astype(np.float32))
 
     # Warm until BOTH workers answer within the deadline (first forward
     # pays each subprocess's XLA compile).
@@ -101,10 +108,14 @@ def test_sigkilled_inference_worker_degrades_to_k_minus_1(served):
     assert dt < TIMEOUT_S + 2.0, f"post-kill batch took {dt:.1f}s"
 
     # Once the lease expires the corpse is dropped from fan-out
-    # entirely: batches stop paying the gather timeout at all.
-    time.sleep(TTL_S + 1.0)
-    assert bus.get_workers(JOB, max_age_s=TTL_S) == ["iw-1"], \
-        "dead worker still holds a fresh lease"
+    # entirely: batches stop paying the gather timeout at all. Poll to
+    # a deadline instead of one sleep+assert: the exact expiry moment
+    # depends on the corpse's LAST heartbeat, which raced the SIGKILL.
+    deadline = time.monotonic() + TTL_S * 4
+    while bus.get_workers(JOB, max_age_s=TTL_S) != ["iw-1"]:
+        assert time.monotonic() < deadline, \
+            "dead worker still holds a fresh lease"
+        time.sleep(0.1)
     t0 = time.monotonic()
     out = pred.predict(queries)
     dt = time.monotonic() - t0

@@ -1,0 +1,266 @@
+"""Unified telemetry layer: registry thread-safety, span nesting,
+snapshot/dump_jsonl, the /metrics endpoints, and program-cache
+re-export through the registry."""
+
+import json
+import threading
+
+import pytest
+
+from rafiki_tpu import telemetry
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    """Each test starts from zeroed metrics (collectors stay: they
+    register once at module import)."""
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_counter_gauge_basics():
+    telemetry.inc("a")
+    telemetry.inc("a", 2.5)
+    assert telemetry.get_counter("a") == 3.5
+    assert telemetry.get_counter("missing") == 0.0
+    telemetry.set_gauge("g", 7)
+    telemetry.add_gauge("g", -2)
+    assert telemetry.get_gauge("g") == 5.0
+
+
+def test_registry_thread_safety():
+    n_threads, n_incs = 8, 5000
+
+    def work():
+        for _ in range(n_incs):
+            telemetry.inc("hammer")
+            telemetry.observe("hist", 1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert telemetry.get_counter("hammer") == n_threads * n_incs
+    snap = telemetry.snapshot()
+    assert snap["histograms"]["hist"]["count"] == n_threads * n_incs
+
+
+def test_histogram_summary_and_reservoir_bound():
+    for v in range(1, 2001):  # > reservoir cap: must stay bounded
+        telemetry.observe("h", float(v))
+    h = telemetry.snapshot()["histograms"]["h"]
+    assert h["count"] == 2000
+    assert h["min"] == 1.0 and h["max"] == 2000.0
+    assert h["sum"] == pytest.approx(2001000.0)
+    # Percentiles come from a uniform reservoir sample: loose sanity.
+    assert 0 < h["p50"] <= 2000
+    assert h["p50"] <= h["p90"] <= h["p99"]
+
+
+def test_collector_appears_in_snapshot_and_survives_errors():
+    # clear_collectors wipes import-time registrations too (e.g. the
+    # ops.train program_cache collector, which only re-registers on a
+    # fresh import) — save and restore them around the wipe.
+    saved = dict(telemetry.get_registry()._collectors)
+    try:
+        telemetry.register_collector("mystats", lambda: {"x": 1})
+        telemetry.register_collector("broken", lambda: 1 / 0)
+        snap = telemetry.snapshot()
+        assert snap["mystats"] == {"x": 1}
+        assert "error" in snap["broken"]
+        telemetry.get_registry().register_collector("mystats", lambda: {"x": 2})
+        assert telemetry.snapshot()["mystats"] == {"x": 2}  # re-register replaces
+        telemetry.reset(clear_collectors=True)
+        assert "mystats" not in telemetry.snapshot()
+    finally:
+        for name, fn in saved.items():
+            telemetry.register_collector(name, fn)
+
+
+# -- spans -------------------------------------------------------------------
+
+
+def test_span_nesting_records_parent():
+    with telemetry.span("outer", job="j1"):
+        with telemetry.span("inner"):
+            pass
+    recs = {r["name"]: r for r in telemetry.span_records()}
+    assert recs["inner"]["parent"] == "outer"
+    assert recs["outer"]["parent"] is None
+    assert recs["outer"]["tags"] == {"job": "j1"}
+    summary = telemetry.snapshot()["spans"]
+    assert summary["outer"]["count"] == 1
+    assert summary["outer"]["total_s"] >= summary["inner"]["total_s"] >= 0
+
+
+def test_span_stack_is_per_thread():
+    seen = {}
+
+    def work(name):
+        with telemetry.span(name):
+            seen[name] = True
+
+    threads = [threading.Thread(target=work, args=(f"t{i}",)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # No cross-thread parenting: every thread's span is a root span.
+    assert all(r["parent"] is None for r in telemetry.span_records())
+
+
+def test_span_records_exception_and_reraises():
+    with pytest.raises(ValueError):
+        with telemetry.span("boom"):
+            raise ValueError("x")
+    (rec,) = telemetry.span_records()
+    assert rec["name"] == "boom" and rec["error"] is True
+    # The stack unwound: the next span is a root, not a child of boom.
+    with telemetry.span("after"):
+        pass
+    assert telemetry.span_records()[-1]["parent"] is None
+
+
+def test_dump_jsonl_and_snapshot_roundtrip(tmp_path):
+    telemetry.inc("c", 2)
+    with telemetry.span("phase"):
+        pass
+    path = tmp_path / "telemetry.jsonl"
+    n = telemetry.dump_jsonl(path)
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert len(lines) == n == 2
+    assert lines[0]["type"] == "span" and lines[0]["name"] == "phase"
+    snap = lines[-1]
+    assert snap["type"] == "snapshot"
+    assert snap["counters"]["c"] == 2
+    assert snap["spans"]["phase"]["count"] == 1
+    json.dumps(telemetry.snapshot())  # always JSON-able
+
+
+# -- program cache re-export -------------------------------------------------
+
+
+def test_program_cache_stats_visible_through_registry():
+    from rafiki_tpu.ops import train as ops_train
+
+    ops_train.clear_program_cache()
+    sentinel = object()
+    key = ("telemetry-test", None, True)
+    assert ops_train.get_program(key, lambda: sentinel) is sentinel  # miss
+    assert ops_train.get_program(key, lambda: None) is sentinel      # hit
+    snap = telemetry.snapshot()
+    assert snap["program_cache"]["misses"] >= 1
+    assert snap["program_cache"]["hits"] >= 1
+    assert snap["counters"]["program_cache.misses"] >= 1
+    assert snap["counters"]["program_cache.hits"] >= 1
+    assert snap["spans"]["program.build"]["count"] >= 1
+    ops_train.clear_program_cache()
+
+
+# -- /metrics endpoints ------------------------------------------------------
+
+
+def test_admin_metrics_endpoint(tmp_config):
+    from werkzeug.test import Client
+
+    from rafiki_tpu.admin import Admin
+    from rafiki_tpu.admin.app import AdminApp
+
+    admin = Admin(config=tmp_config)
+    try:
+        telemetry.inc("test.admin_metric", 3)
+        client = Client(AdminApp(admin))
+        resp = client.get("/metrics")  # no auth required, like /healthz
+        assert resp.status_code == 200
+        body = json.loads(resp.get_data(as_text=True))
+        assert body["counters"]["test.admin_metric"] == 3
+        # Same registry state as the in-process API, not a copy.
+        assert body["counters"] == telemetry.snapshot()["counters"]
+    finally:
+        admin.stop()
+
+
+def test_predictor_metrics_endpoint():
+    from werkzeug.test import Client
+
+    from rafiki_tpu.bus import InProcBus
+    from rafiki_tpu.predictor.app import PredictorApp
+    from rafiki_tpu.predictor.predictor import Predictor
+
+    telemetry.inc("test.pred_metric")
+    with telemetry.span("test.pred_span"):
+        pass
+    app = PredictorApp(Predictor(InProcBus(), "nojob"))
+    resp = Client(app).get("/metrics")
+    assert resp.status_code == 200
+    body = json.loads(resp.get_data(as_text=True))
+    assert body["counters"]["test.pred_metric"] == 1
+    assert body["spans"]["test.pred_span"]["count"] == 1
+
+
+# -- serving-path introspection ----------------------------------------------
+
+
+def test_predictor_no_live_workers_is_counted_and_raised():
+    from rafiki_tpu.bus import InProcBus
+    from rafiki_tpu.predictor.predictor import Predictor
+
+    import time as _time
+
+    bus = InProcBus()
+    bus.add_worker("j", "w-dead")
+    _time.sleep(0.01)
+    # Stale lease (no heartbeat): the predictor must fail fast, not fan
+    # out to the corpse and report per-query timeouts.
+    pred = Predictor(bus, "j", timeout_s=0.5, worker_ttl_s=0.0)
+    with pytest.raises(RuntimeError, match="no live inference workers"):
+        pred.predict([[1.0]])
+    assert telemetry.get_counter("predictor.no_live_workers") == 1
+
+
+def test_bus_reap_stale_removes_corpse_and_counts():
+    import time as _time
+
+    from rafiki_tpu.bus import InProcBus
+
+    bus = InProcBus()
+    bus.add_worker("j", "w1")
+    bus.add_worker("j", "w2")
+    bus.add_query("w1", "q1", [1.0])
+    _time.sleep(0.05)
+    bus.heartbeat("j", "w2")  # w2 stays fresh, w1 goes stale
+    reaped = bus.reap_stale(max_age_s=0.04, job_id="j")
+    assert reaped == [("j", "w1")]
+    assert bus.get_workers("j") == ["w2"]
+    assert bus.pop_queries("w1", timeout=0.01) == []  # queue deleted too
+    assert telemetry.get_counter("bus.reaped_workers") == 1
+    # Reaping never touches fresh leases.
+    assert bus.reap_stale(max_age_s=60.0) == []
+
+
+def test_mp_bus_reap_stale_same_contract():
+    from rafiki_tpu.bus import make_mp_bus
+
+    bus = make_mp_bus()
+    bus.add_worker("j", "w1")
+    bus.add_query("w1", "q1", [1.0])
+    assert bus.reap_stale(max_age_s=60.0) == []       # fresh: kept
+    reaped = bus.reap_stale(max_age_s=-1.0)           # force-stale: reaped
+    assert reaped == [("j", "w1")]
+    assert bus.get_workers("j") == []
+    assert bus.pop_queries("w1", timeout=0.01) == []
+
+
+def test_bus_heartbeat_of_unknown_job_does_not_leak():
+    from rafiki_tpu.bus import InProcBus
+
+    bus = InProcBus()
+    for i in range(50):  # defaultdict used to materialize one set per probe
+        bus.heartbeat(f"ghost-{i}", "w")
+        bus.get_workers(f"ghost2-{i}")
+    assert bus._workers == {}
