@@ -608,8 +608,10 @@ def run_micro(sc: dict, detail: dict) -> None:
 
     # MFU only means something on the hardware whose peak is the
     # denominator: off-TPU both fields are null, not a rounded 0.0
-    # (r4 verdict, Weak #2).
-    on_tpu = sc["platform"] == "tpu"
+    # (r4 verdict, Weak #2). Gate on != "cpu", not == "tpu": this
+    # image's PJRT plugin registers the TPU as platform "axon", and the
+    # == "tpu" form silently nulled MFU on every green-window run.
+    on_tpu = sc["platform"] != "cpu"
     mfu = mfu_model = None
     if on_tpu:
         try:  # whole-program flops from XLA's own cost model
@@ -670,12 +672,16 @@ def main() -> None:
     detail = _OUT["detail"]
     try:
         platform = _init_backend()
+        # Always recorded, even on failure paths below: a green-window
+        # artifact with mfu null must say WHICH platform produced it.
+        detail["platform"] = platform
         from rafiki_tpu.utils.backend import enable_compilation_cache
 
         detail["xla_cache_dir"] = enable_compilation_cache()
         import jax
 
         detail["device"] = str(jax.devices()[0])
+        detail["device_kind"] = getattr(jax.devices()[0], "device_kind", "")
         # Test hook: deterministic stall for the watchdog test (the
         # real run's duration depends on cache warmth).
         stall = float(os.environ.get("RAFIKI_BENCH_SELFTEST_SLEEP_S", "0"))

@@ -27,6 +27,13 @@ def main(argv=None) -> int:
     sub.add_parser("version", help="print version")
 
     args = parser.parse_args(argv)
+    # Pin the platform before ANY branch touches jax (the serve path
+    # imports the admin stack, which imports jax transitively, and
+    # enable_compilation_cache imports jax itself): a JAX_PLATFORMS=cpu
+    # request must survive this image's sitecustomize TPU hijack.
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()
     if args.command == "serve":
         from rafiki_tpu.admin.app import serve
         from rafiki_tpu.utils.backend import enable_compilation_cache

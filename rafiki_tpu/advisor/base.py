@@ -139,8 +139,10 @@ class BaseAdvisor:
     def _pending_add(self, x: np.ndarray) -> None:
         """Record a proposal awaiting its score; capped on EVERY append
         (an uncapped path would grow forever under lost feedbacks)."""
+        # lint: disable=RF004 — locked-caller contract: only reached from propose() which holds self._lock
         self._pending.append(x)
         while len(self._pending) > self.PENDING_CAP:
+            # lint: disable=RF004 — same locked-caller contract as the append above
             self._pending.pop(0)
 
     def _pending_dists(self, cand: np.ndarray, span: np.ndarray):

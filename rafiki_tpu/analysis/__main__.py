@@ -1,0 +1,5 @@
+import sys
+
+from rafiki_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,7 @@
+"""Built-in checkers, one module per id.
+
+Every module in this package is imported by
+``rafiki_tpu.analysis.core.load_builtin_checkers`` (pkgutil walk) and
+registers its checker class on import — dropping a new ``rf00x.py``
+here IS the plugin mechanism; nothing else to wire up.
+"""

@@ -1,0 +1,65 @@
+"""Small AST helpers shared by the checkers (not a checker itself —
+the plugin loader imports it harmlessly; it registers nothing)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target: ``jax.jit`` for jax.jit(...),
+    ``f`` for f(...); "" when the callee isn't a plain name chain."""
+    return dotted_name(node.func)
+
+
+def dotted_name(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_self_attr(node: ast.AST, attrs: Optional[Set[str]] = None) -> Optional[str]:
+    """If ``node`` is ``self.<attr>`` (optionally restricted to
+    ``attrs``), return the attr name."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and (attrs is None or node.attr in attrs)):
+        return node.attr
+    return None
+
+
+def module_functions(tree: ast.Module) -> Iterator[ast.FunctionDef]:
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def dunder_main_block(tree: ast.Module) -> Optional[ast.If]:
+    """The module's ``if __name__ == "__main__":`` statement, if any."""
+    for node in tree.body:
+        if not isinstance(node, ast.If):
+            continue
+        t = node.test
+        if (isinstance(t, ast.Compare) and len(t.ops) == 1
+                and isinstance(t.ops[0], ast.Eq)
+                and isinstance(t.left, ast.Name) and t.left.id == "__name__"
+                and len(t.comparators) == 1
+                and isinstance(t.comparators[0], ast.Constant)
+                and t.comparators[0].value == "__main__"):
+            return node
+    return None

@@ -1,0 +1,51 @@
+"""RF002 platform-literal-gate.
+
+Historical bug (round 5, bench.py:607): the bench's MFU fields were
+gated on ``platform == "tpu"``, but this image's PJRT plugin registers
+the TPU as platform ``"axon"`` — every green-window run silently
+reported ``mfu: null`` and the window's evidence was lost.
+
+Rule: never equality-compare a platform string against the literal
+``"tpu"``. The robust gates are ``platform != "cpu"`` (anything that
+isn't the host is an accelerator) or a device_kind check
+(``"TPU" in jax.devices()[0].device_kind``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+
+
+@register
+class PlatformLiteralGate(Checker):
+    id = "RF002"
+    name = "platform-literal-gate"
+    severity = "error"
+    rationale = ('`== "tpu"` misses TPU-backed platforms with other PJRT '
+                 'names (this image registers "axon") — gate on != "cpu" '
+                 'or device_kind instead')
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            sides = [node.left] + list(node.comparators)
+            has_tpu_literal = any(
+                # lint: disable=RF002 — the checker must name the literal it hunts
+                isinstance(s, ast.Constant) and s.value == "tpu"
+                for s in sides)
+            if not has_tpu_literal:
+                continue
+            if not any(isinstance(op, (ast.Eq, ast.NotEq)) for op in node.ops):
+                continue
+            findings.append(self.finding(
+                ctx, node,
+                'platform compared against the literal "tpu": TPU-backed '
+                'PJRT plugins register other names (this image: "axon"), '
+                'so the gate silently takes the wrong branch on real '
+                'hardware — use != "cpu" or check device_kind for "TPU"'))
+        return findings

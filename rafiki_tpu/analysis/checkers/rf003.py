@@ -1,0 +1,84 @@
+"""RF003 defaultdict-read-leak.
+
+Historical bug (fixed in PR 1, bus/queues.py): ``InProcBus._workers``
+was a ``defaultdict(set)`` and the *read* paths — ``heartbeat`` of a
+removed worker, ``get_workers`` of a finished job — indexed it
+directly, silently materializing an empty set per probed job id: a
+slow, unbounded leak on any long-lived bus polled with rotating ids.
+
+Rule: in a class that assigns ``self.X = defaultdict(...)``, a
+Load-context subscript ``self.X[k]`` whose result is *not* immediately
+mutated (``self.X[k].append(v)`` and friends are the intended
+insert-on-first-use idiom) is a read that inserts — use
+``self.X.get(k, default)`` instead, or switch to a plain dict.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+from rafiki_tpu.analysis.checkers._ast_util import parent_map, is_self_attr
+
+# mutating the subscripted entry in place = insertion is the point
+_MUTATORS = {"append", "add", "extend", "update", "insert", "setdefault",
+             "appendleft", "extendleft", "push", "put"}
+
+
+def _defaultdict_attrs(cls: ast.ClassDef) -> Set[str]:
+    attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            fn = value.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if name != "defaultdict":
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                attr = is_self_attr(t)
+                if attr:
+                    attrs.add(attr)
+    return attrs
+
+
+@register
+class DefaultdictReadLeak(Checker):
+    id = "RF003"
+    name = "defaultdict-read-leak"
+    severity = "warning"
+    rationale = ("a Load subscript on a defaultdict attribute inserts on "
+                 "miss — read paths leak one entry per probed key "
+                 "(the PR-1 bus registry leak)")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for cls in [n for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            dd_attrs = _defaultdict_attrs(cls)
+            if not dd_attrs:
+                continue
+            parents = parent_map(cls)
+            for node in ast.walk(cls):
+                if not (isinstance(node, ast.Subscript)
+                        and isinstance(node.ctx, ast.Load)
+                        and is_self_attr(node.value, dd_attrs)):
+                    continue
+                parent = parents.get(node)
+                # self.X[k].append(v): Subscript -> Attribute(mutator) -> Call
+                if (isinstance(parent, ast.Attribute)
+                        and parent.attr in _MUTATORS
+                        and isinstance(parents.get(parent), ast.Call)):
+                    continue
+                attr = is_self_attr(node.value, dd_attrs)
+                findings.append(self.finding(
+                    ctx, node,
+                    f"read-side subscript of defaultdict attribute "
+                    f"`self.{attr}` inserts an empty entry on every probed "
+                    f"key (unbounded leak under rotating keys) — use "
+                    f"`self.{attr}.get(...)` or a plain dict"))
+        return findings

@@ -1,0 +1,144 @@
+"""RF004 unguarded-shared-mutation.
+
+Failure class: the bus, the telemetry registry and the advisor service
+are all mutated from many threads (trial workers, HTTP handlers,
+heartbeat daemons). Each owns a lock — but a lock only helps when
+every mutation of the shared dict/list state actually holds it, and a
+method added later that skips the ``with self._lock:`` compiles, runs,
+and corrupts state only under load.
+
+Rule: in a class that assigns a lock attribute in ``__init__``
+(``threading.Lock/RLock/Condition`` or a manager's ``.Lock()``), any
+mutation of ``self.<attr>`` container state — subscript assignment,
+``del``, augmented assignment, or a mutating method call
+(``append``/``pop``/``update``/...) — outside a ``with self.<lock>:``
+block is flagged. ``__init__``/``__getstate__``/``__setstate__`` are
+exempt (construction and pickling are single-threaded by contract).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+from rafiki_tpu.analysis.checkers._ast_util import dotted_name, is_self_attr
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_MUTATORS = {"append", "add", "extend", "update", "insert", "setdefault",
+             "pop", "popitem", "clear", "remove", "discard", "appendleft",
+             "extendleft", "popleft", "sort", "reverse"}
+_EXEMPT_METHODS = {"__init__", "__getstate__", "__setstate__", "__del__",
+                   "__reduce__", "__copy__", "__deepcopy__"}
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            name = dotted_name(node.value.func)
+            if name.rsplit(".", 1)[-1] in _LOCK_CTORS:
+                for t in node.targets:
+                    attr = is_self_attr(t)
+                    if attr:
+                        attrs.add(attr)
+    return attrs
+
+
+class _MethodVisitor(ast.NodeVisitor):
+    """Walks one method tracking whether a ``with self.<lock>:`` is
+    held on the current path; nested functions are visited with the
+    hold state of their definition site (threads started on unlocked
+    nested fns are beyond static reach — the conservative choice)."""
+
+    def __init__(self, checker: "UnguardedSharedMutation",
+                 ctx: ModuleContext, lock_attrs: Set[str],
+                 findings: List[Finding]):
+        self.checker = checker
+        self.ctx = ctx
+        self.lock_attrs = lock_attrs
+        self.findings = findings
+        self.depth = 0  # nesting depth of held self-lock withs
+
+    def _is_self_lock(self, expr: ast.AST) -> bool:
+        return is_self_attr(expr, self.lock_attrs) is not None
+
+    def visit_With(self, node: ast.With) -> None:
+        held = any(self._is_self_lock(item.context_expr)
+                   for item in node.items)
+        if held:
+            self.depth += 1
+        self.generic_visit(node)
+        if held:
+            self.depth -= 1
+
+    def _flag(self, node: ast.AST, attr: str, what: str) -> None:
+        if self.depth == 0:
+            self.findings.append(self.checker.finding(
+                self.ctx, node,
+                f"{what} of shared `self.{attr}` outside the class's lock "
+                f"— every mutation in a lock-owning class must hold it "
+                f"(wrap in `with self.{sorted(self.lock_attrs)[0]}:`)"))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                attr = is_self_attr(t.value)
+                if attr:
+                    self._flag(node, attr, "subscript assignment")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        target: Optional[ast.AST] = node.target
+        if isinstance(target, ast.Subscript):
+            attr = is_self_attr(target.value)
+            if attr:
+                self._flag(node, attr, "augmented assignment")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                attr = is_self_attr(t.value)
+                if attr:
+                    self._flag(node, attr, "del")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS:
+            attr = is_self_attr(fn.value)
+            if attr and attr not in self.lock_attrs:
+                self._flag(node, attr, f".{fn.attr}()")
+            # self.X[k].append(...) — mutation of a shared entry
+            elif (isinstance(fn.value, ast.Subscript)):
+                sub_attr = is_self_attr(fn.value.value)
+                if sub_attr:
+                    self._flag(node, sub_attr, f"[...] .{fn.attr}()")
+        self.generic_visit(node)
+
+
+@register
+class UnguardedSharedMutation(Checker):
+    id = "RF004"
+    name = "unguarded-shared-mutation"
+    severity = "warning"
+    rationale = ("a lock-owning class mutating its shared dict/list state "
+                 "without holding the lock corrupts state only under "
+                 "load — bus/telemetry/advisor class of bug")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for cls in [n for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            lock_attrs = _lock_attrs(cls)
+            if not lock_attrs:
+                continue
+            for item in cls.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if item.name in _EXEMPT_METHODS:
+                    continue
+                _MethodVisitor(self, ctx, lock_attrs, findings).visit(item)
+        return findings

@@ -1,0 +1,144 @@
+"""RF005 jit-hazard.
+
+Failure class: the hot path (`ops/`, `parallel/`) is only fast while
+its jitted programs stay jitted. Three mechanical ways to lose that:
+
+  * Python ``if``/``while`` on a *traced* value inside a jitted
+    function — a TracerBoolConversionError at best, a silent
+    per-value recompile when the value is marked static;
+  * host syncs (``.item()``, ``float(...)``/``int(...)``,
+    ``np.asarray(...)``) inside a jitted function — each one stalls
+    the device pipeline on a device->host transfer;
+  * constructing ``jax.jit(...)`` inside a loop — every iteration
+    makes a fresh callable with a fresh (empty) compile cache.
+
+Rule, applied to functions this module passes to ``jax.jit`` (or
+decorates with it): flag host-sync calls, ``jax.jit`` calls inside
+``for``/``while`` bodies anywhere in the module, and ``if``/``while``
+whose test references a function parameter through an order comparison
+or bare truthiness (``in``/``is`` tests are trace-time static and
+stay legal).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+from rafiki_tpu.analysis.checkers._ast_util import dotted_name
+
+_HOST_SYNC_CALLS = {"float", "int", "bool"}
+_HOST_SYNC_ATTRS = {"item", "tolist"}
+_NP_SYNC = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+def _jitted_function_names(tree: ast.Module) -> Set[str]:
+    """Names of functions passed to jax.jit(...) or decorated @jax.jit
+    anywhere in the module (nested defs included — ops.train builds its
+    steps inside Program.__init__)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func)
+            if callee.endswith("jit") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if dotted_name(target).endswith("jit"):
+                    names.add(node.name)
+    return names
+
+
+def _params_of(fn: ast.FunctionDef) -> Set[str]:
+    a = fn.args
+    return {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)} | (
+        {a.vararg.arg} if a.vararg else set()) | (
+        {a.kwarg.arg} if a.kwarg else set())
+
+
+def _test_trips_on_param(test: ast.AST, params: Set[str]) -> bool:
+    """True when the branch condition's truthiness can depend on a
+    traced parameter: a bare param name, or a param inside an order/
+    equality comparison or arithmetic. `x in d` / `x is None` are
+    resolved at trace time and excluded."""
+    if isinstance(test, ast.Name):
+        return test.id in params
+    if isinstance(test, ast.Compare):
+        if all(isinstance(op, (ast.In, ast.NotIn, ast.Is, ast.IsNot))
+               for op in test.ops):
+            return False
+        return any(isinstance(n, ast.Name) and n.id in params
+                   for n in ast.walk(test))
+    if isinstance(test, ast.BoolOp):
+        return any(_test_trips_on_param(v, params) for v in test.values)
+    if isinstance(test, ast.UnaryOp):
+        return _test_trips_on_param(test.operand, params)
+    if isinstance(test, (ast.BinOp, ast.Subscript, ast.Attribute, ast.Call)):
+        return any(isinstance(n, ast.Name) and n.id in params
+                   for n in ast.walk(test))
+    return False
+
+
+@register
+class JitHazard(Checker):
+    id = "RF005"
+    name = "jit-hazard"
+    severity = "warning"
+    rationale = ("python control flow on traced values, host syncs inside "
+                 "jitted fns, and jax.jit built inside loops all silently "
+                 "destroy the compile-once model the hot path depends on")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        jitted = _jitted_function_names(ctx.tree)
+
+        # jax.jit constructed inside a loop — module-wide
+        for loop in [n for n in ast.walk(ctx.tree)
+                     if isinstance(n, (ast.For, ast.While))]:
+            for node in ast.walk(loop):
+                if (isinstance(node, ast.Call)
+                        and dotted_name(node.func) in ("jax.jit", "jit",
+                                                       "jax.pmap", "pmap")):
+                    findings.append(self.finding(
+                        ctx, node,
+                        f"`{dotted_name(node.func)}(...)` constructed inside "
+                        f"a loop: each iteration builds a fresh callable "
+                        f"with an empty compile cache — hoist the jit out "
+                        f"of the loop"))
+
+        if not jitted:
+            return findings
+        for fn in [n for n in ast.walk(ctx.tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and n.name in jitted]:
+            params = _params_of(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    if _test_trips_on_param(node.test, params):
+                        kind = "if" if isinstance(node, ast.If) else "while"
+                        findings.append(self.finding(
+                            ctx, node,
+                            f"python `{kind}` on a value derived from "
+                            f"traced parameter(s) inside jitted "
+                            f"`{fn.name}` — use jnp.where / lax.cond, or "
+                            f"mark the argument static"))
+                elif isinstance(node, ast.Call):
+                    callee = dotted_name(node.func)
+                    leaf = callee.rsplit(".", 1)[-1]
+                    if ((callee in _NP_SYNC)
+                            or (leaf in _HOST_SYNC_ATTRS
+                                and isinstance(node.func, ast.Attribute))
+                            or (callee in _HOST_SYNC_CALLS and node.args
+                                and not isinstance(node.args[0],
+                                                   ast.Constant))):
+                        findings.append(self.finding(
+                            ctx, node,
+                            f"host sync `{callee}(...)` inside jitted "
+                            f"`{fn.name}`: forces a device->host transfer "
+                            f"per call (or fails to trace) — keep values "
+                            f"on device or move the sync outside the jit"))
+        return findings

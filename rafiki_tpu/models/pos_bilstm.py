@@ -82,6 +82,12 @@ class PosBiLstm(JaxModel):
 
 
 if __name__ == "__main__":
+    # Dev harness run (`python -m rafiki_tpu.models.X`): pin the
+    # platform first or the image's sitecustomize TPU hijack hangs
+    # backend init when the tunnel is down.
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()
     from rafiki_tpu.model.dev import test_model_class
 
     test_model_class(

@@ -60,8 +60,14 @@ def _free_ports(n: int) -> List[int]:
 
 def worker_device_env(platform: str, worker_index: int,
                       devices_per_trial: int = 1) -> Dict[str, str]:
-    """Env vars that pin a worker subprocess to its own device set."""
-    if platform == "tpu":
+    """Env vars that pin a worker subprocess to its own device set.
+
+    Anything that isn't the host platform gets the TPU chip-pinning
+    env: PJRT plugins register TPUs under other names (this image:
+    "axon"), and the old ``== "tpu"`` gate sent those workers down the
+    CPU branch — forcing JAX_PLATFORMS=cpu on a real TPU run.
+    """
+    if platform != "cpu":
         first = worker_index * devices_per_trial
         chips = ",".join(str(first + j) for j in range(devices_per_trial))
         return {
@@ -91,23 +97,50 @@ class _WorkerGroup:
         self.leader_worker_id = ""
         self.restarts = 0
         self.respawn_at: Optional[float] = None  # monotonic; None = live
+        # Monotonic time a follower was first seen exited rc=0 while the
+        # leader still ran; None while the group is whole. See state().
+        self.partial_exit_at: Optional[float] = None
         # Service rows of every dead predecessor in this slot: the
         # replacement must sweep them ALL — a restart that crashed
         # before adopting leaves the orphan bound to an older corpse.
         self.dead_services: List[str] = []
+
+    # A follower that exits rc=0 mid-trial is just as gone as one that
+    # crashed — the leader's next collective will never complete — but
+    # a zero rc can also be the harmless tail of a clean group
+    # shutdown racing the poll. The grace window separates the two:
+    # long enough for the leader's own clean exit to land, far shorter
+    # than the collective transport timeout (minutes) that used to be
+    # the only thing ending the wedge (round-4 ADVICE d).
+    FOLLOWER_EXIT_GRACE_S = 15.0
 
     def state(self) -> str:
         """'running' | 'ok' | 'failed'. A member dead non-zero while the
         leader hasn't exited cleanly fails the whole group immediately —
         the survivors are inside (or headed into) collectives their dead
         peer will never join, and waiting for the transport timeout to
-        tell us so would wedge the job for minutes."""
+        tell us so would wedge the job for minutes. A member dead rc=0
+        while the leader lives fails the group too, after a bounded
+        grace window (see FOLLOWER_EXIT_GRACE_S)."""
         rcs = [p.poll() for p in self.procs]
         if any(rc is None for rc in rcs):
             if any(rc not in (0, None) for rc in rcs) and rcs[0] != 0:
                 return "failed"
+            if rcs[0] is None and any(rc == 0 for rc in rcs[1:]):
+                now = time.monotonic()
+                if self.partial_exit_at is None:
+                    self.partial_exit_at = now
+                elif now - self.partial_exit_at > self._follower_exit_grace_s():
+                    return "failed"
+            else:
+                self.partial_exit_at = None
             return "running"
+        self.partial_exit_at = None
         return "ok" if rcs[0] == 0 else "failed"
+
+    def _follower_exit_grace_s(self) -> float:
+        return float(os.environ.get("RAFIKI_FOLLOWER_EXIT_GRACE_S",
+                                    self.FOLLOWER_EXIT_GRACE_S))
 
     def terminate(self) -> None:
         for p in self.procs:
@@ -267,6 +300,7 @@ class ProcessScheduler:
 
         job, sub = ctx["job"], ctx["sub"]
         platform, mh = ctx["platform"], ctx["multihost"]
+        g.partial_exit_at = None  # fresh process set, fresh grace
         service = self.store.create_service(
             ServiceType.TRAIN_WORKER.value, job_id=job["id"],
             worker_index=g.index, devices=[f"{platform}:{g.index}"])
@@ -282,7 +316,7 @@ class ProcessScheduler:
         g.leader_worker_id = leader_worker_id
         for j in range(mh):
             env = dict(os.environ)
-            if not (platform == "tpu" and mh > 1):
+            if platform == "cpu" or mh <= 1:
                 env.update(worker_device_env(
                     platform, g.index * mh + j, ctx["devices_per_trial"]))
             # else: a real multi-host TPU group must keep the pod
@@ -423,6 +457,14 @@ class ProcessScheduler:
                     continue
                 # state == "failed": tear down, then restart or give up.
                 failures = g.shutdown()
+                if not failures and g.partial_exit_at is not None:
+                    # rc=0 exits are never blamed by shutdown(), so the
+                    # follower-gone-clean wedge needs its own message.
+                    failures = [
+                        f"worker {g.index}: follower exited rc=0 mid-trial "
+                        f"while the leader lived; group failed after "
+                        f"{g._follower_exit_grace_s():.0f}s grace"]
+                g.partial_exit_at = None
                 self.store.update_service(
                     g.service["id"], status=ServiceStatus.ERRORED.value)
                 if g.restarts < max_restarts:

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Static-analysis gate: zero unsuppressed findings over the canonical
+# path set (see docs/static_analysis.md). Same checkers, same paths as
+# tests/test_lint_clean.py — this is the shell-visible form CI and
+# check_tier1.sh use. JSON output so a failing run leaves a
+# machine-readable artifact on stdout.
+set -o pipefail
+cd "$(dirname "$0")/.."
+env JAX_PLATFORMS=cpu python -m rafiki_tpu.analysis rafiki_tpu bench.py scripts --format json
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "check_lint: unsuppressed findings (or parse errors) — run" >&2
+  echo "  python -m rafiki_tpu.analysis rafiki_tpu bench.py scripts" >&2
+  echo "and fix or justify-suppress each (docs/static_analysis.md)." >&2
+fi
+exit $rc

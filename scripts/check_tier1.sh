@@ -8,6 +8,10 @@
 # summary; exits with pytest's own return code.
 set -o pipefail
 cd "$(dirname "$0")/.."
+# Lint gate first: a static-analysis regression fails the same gate as
+# tests (docs/static_analysis.md). Cheap (~1s, no jax touch), so it
+# runs before the 870s pytest budget is spent.
+scripts/check_lint.sh > /tmp/_lint.json || { echo "TIER1 LINT FAILED (see /tmp/_lint.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

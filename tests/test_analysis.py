@@ -1,0 +1,432 @@
+"""The analyzer proves itself against the bugs it encodes: every
+checker fires on its bad fixture (including the PRE-FIX forms of the
+two real round-5 bugs, reconstructed from the live files) and stays
+silent on the good one."""
+
+import os
+import textwrap
+
+import pytest
+
+from rafiki_tpu.analysis import analyze_paths, load_builtin_checkers
+from rafiki_tpu.analysis.core import REGISTRY, module_name_for
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+load_builtin_checkers()
+
+
+def _ids(result, path=None):
+    return sorted({f.checker_id for f in result.unsuppressed
+                   if path is None or f.path == str(path)})
+
+
+def _analyze_snippet(tmp_path, source, name="snippet.py", select=None):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return analyze_paths([str(f)], select=select)
+
+
+def test_all_five_checkers_registered():
+    assert {"RF001", "RF002", "RF003", "RF004", "RF005"} <= set(REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# RF001 entrypoint-platform-pin
+# ---------------------------------------------------------------------------
+
+
+def test_rf001_fires_on_unpinned_jax_entrypoint(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        import jax
+
+        def run_worker_process(meta_path):
+            return jax.devices()
+
+        def main():
+            run_worker_process("x")
+
+        if __name__ == "__main__":
+            main()
+        """)
+    # run_*_process AND main AND the __main__ block (whose only call,
+    # main(), does not pin) are all unpinned
+    assert [f.checker_id for f in r.unsuppressed].count("RF001") == 3
+
+
+def test_rf001_quiet_when_pinned_before_touch(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        import jax
+        from rafiki_tpu.utils.backend import honor_env_platform
+
+        def main():
+            honor_env_platform()
+            return jax.devices()
+
+        if __name__ == "__main__":
+            main()
+        """)
+    assert "RF001" not in _ids(r)
+
+
+def test_rf001_fires_when_jax_touched_before_pin(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        import jax
+        from rafiki_tpu.utils.backend import honor_env_platform
+
+        def main():
+            devices = jax.devices()
+            honor_env_platform()
+            return devices
+        """)
+    found = [f for f in r.unsuppressed if f.checker_id == "RF001"]
+    assert len(found) == 1 and "before the platform pin" in found[0].message
+
+
+def test_rf001_pin_through_local_helper_chain(tmp_path):
+    # bench.py's shape: main -> _init_backend -> honor_env_platform
+    r = _analyze_snippet(tmp_path, """
+        import jax
+
+        def _init_backend():
+            from rafiki_tpu.utils.backend import honor_env_platform
+            honor_env_platform()
+
+        def main():
+            _init_backend()
+            return jax.devices()
+
+        if __name__ == "__main__":
+            main()
+        """)
+    assert "RF001" not in _ids(r)
+
+
+def test_rf001_ignores_jaxfree_entrypoints(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        import json
+
+        def main():
+            print(json.dumps({}))
+
+        if __name__ == "__main__":
+            main()
+        """)
+    assert "RF001" not in _ids(r)
+
+
+def test_rf001_real_prefix_inference_worker(tmp_path):
+    """The round-5 bug verbatim: worker/inference.py WITHOUT the
+    honor_env_platform() call, analyzed against the real tree (the jax
+    taint arrives transitively through rafiki_tpu.model.base)."""
+    live = open(os.path.join(REPO, "rafiki_tpu/worker/inference.py")).read()
+    assert "honor_env_platform" in live  # the fix is present today
+    prefix = "\n".join(l for l in live.splitlines()
+                       if "honor_env_platform" not in l)
+    bad = tmp_path / "inference_prefix.py"
+    bad.write_text(prefix)
+    r = analyze_paths([str(bad), os.path.join(REPO, "rafiki_tpu")],
+                      select=["RF001"])
+    mine = [f for f in r.unsuppressed if f.path == str(bad)]
+    assert [f.checker_id for f in mine] == ["RF001"]
+    assert "run_inference_worker_process" in mine[0].message
+
+
+def test_rf001_current_inference_worker_is_clean():
+    r = analyze_paths([os.path.join(REPO, "rafiki_tpu")], select=["RF001"])
+    assert [f for f in r.unsuppressed
+            if f.path.endswith("worker/inference.py")] == []
+
+
+# ---------------------------------------------------------------------------
+# RF002 platform-literal-gate
+# ---------------------------------------------------------------------------
+
+
+def test_rf002_fires_on_tpu_literal_compare(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        def gate(platform):
+            if platform == "tpu":
+                return 1
+            if "tpu" != platform:
+                return 2
+        """)
+    assert [f.checker_id for f in r.unsuppressed] == ["RF002", "RF002"]
+
+
+def test_rf002_quiet_on_cpu_gate_and_membership(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        def gate(platform, device_kind):
+            on_accel = platform != "cpu"
+            return on_accel or "TPU" in device_kind or platform in ("tpu",)
+        """)
+    assert "RF002" not in _ids(r)
+
+
+def test_rf002_real_prefix_bench_mfu_gate(tmp_path):
+    """The round-5 bug verbatim: bench.py's MFU gate reverted to the
+    == "tpu" form that nulled MFU under this image's "axon" platform."""
+    live = open(os.path.join(REPO, "bench.py")).read()
+    assert 'sc["platform"] != "cpu"' in live  # the fix is present today
+    prefix = live.replace('sc["platform"] != "cpu"', 'sc["platform"] == "tpu"')
+    bad = tmp_path / "bench_prefix.py"
+    bad.write_text(prefix)
+    r = analyze_paths([str(bad)], select=["RF002"])
+    assert [f.checker_id for f in r.unsuppressed] == ["RF002"]
+
+
+def test_rf002_current_bench_is_clean():
+    r = analyze_paths([os.path.join(REPO, "bench.py")], select=["RF002"])
+    assert r.unsuppressed == []
+
+
+# ---------------------------------------------------------------------------
+# RF003 defaultdict-read-leak
+# ---------------------------------------------------------------------------
+
+RF003_BAD = """
+    from collections import defaultdict
+
+    class Bus:
+        def __init__(self):
+            self._workers = defaultdict(set)
+
+        def get_workers(self, job_id):
+            return sorted(self._workers[job_id])
+
+        def heartbeat(self, job_id, worker_id):
+            if worker_id in self._workers[job_id]:
+                pass
+    """
+
+RF003_GOOD = """
+    from collections import defaultdict
+
+    class Bus:
+        def __init__(self):
+            self._workers = defaultdict(set)
+            self._plain = {}
+
+        def add_worker(self, job_id, worker_id):
+            self._workers[job_id].add(worker_id)
+
+        def get_workers(self, job_id):
+            return sorted(self._workers.get(job_id, ()))
+
+        def read_plain(self, job_id):
+            return self._plain[job_id]
+    """
+
+
+def test_rf003_fires_on_read_side_subscript(tmp_path):
+    r = _analyze_snippet(tmp_path, RF003_BAD)
+    assert [f.checker_id for f in r.unsuppressed] == ["RF003", "RF003"]
+
+
+def test_rf003_quiet_on_insert_idiom_and_get(tmp_path):
+    r = _analyze_snippet(tmp_path, RF003_GOOD)
+    assert "RF003" not in _ids(r)
+
+
+# ---------------------------------------------------------------------------
+# RF004 unguarded-shared-mutation
+# ---------------------------------------------------------------------------
+
+RF004_BAD = """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._counters = {}
+            self._events = []
+
+        def inc(self, name):
+            self._counters[name] = self._counters.get(name, 0) + 1
+
+        def log(self, ev):
+            self._events.append(ev)
+    """
+
+RF004_GOOD = """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._counters = {}
+            self._events = []
+
+        def inc(self, name):
+            with self._lock:
+                self._counters[name] = self._counters.get(name, 0) + 1
+
+        def log(self, ev):
+            with self._lock:
+                self._events.append(ev)
+
+    class NoLockNoRules:
+        def __init__(self):
+            self._events = []
+
+        def log(self, ev):
+            self._events.append(ev)
+    """
+
+
+def test_rf004_fires_on_unlocked_mutation(tmp_path):
+    r = _analyze_snippet(tmp_path, RF004_BAD)
+    assert [f.checker_id for f in r.unsuppressed] == ["RF004", "RF004"]
+
+
+def test_rf004_quiet_under_lock_and_in_lockless_classes(tmp_path):
+    r = _analyze_snippet(tmp_path, RF004_GOOD)
+    assert "RF004" not in _ids(r)
+
+
+def test_rf004_condition_counts_as_lock(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        import threading
+
+        class Slots:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._preds = {}
+
+            def put(self, k, v):
+                with self._cv:
+                    self._preds.setdefault(k, []).append(v)
+                    self._cv.notify_all()
+        """)
+    assert "RF004" not in _ids(r)
+
+
+# ---------------------------------------------------------------------------
+# RF005 jit-hazard
+# ---------------------------------------------------------------------------
+
+RF005_BAD = """
+    import jax
+    import numpy as np
+
+    def train_step(state, batch):
+        if state > 0:
+            state = state - 1
+        loss = float(batch.mean())
+        host = np.asarray(batch)
+        return state, loss, host
+
+    train_step = jax.jit(train_step)
+
+    def rebuild_per_iteration(xs):
+        outs = []
+        for x in xs:
+            outs.append(jax.jit(lambda v: v + 1)(x))
+        return outs
+    """
+
+RF005_GOOD = """
+    import jax
+    import jax.numpy as jnp
+
+    def train_step(state, batch):
+        state = jnp.where(state > 0, state - 1, state)
+        if "valid" in batch:
+            pass
+        return state
+
+    train_step = jax.jit(train_step)
+
+    _step = jax.jit(lambda v: v + 1)
+
+    def apply_all(xs):
+        return [float(_step(x)) for x in xs]
+    """
+
+
+def test_rf005_fires_on_branch_sync_and_jit_in_loop(tmp_path):
+    r = _analyze_snippet(tmp_path, RF005_BAD)
+    msgs = [f.message for f in r.unsuppressed if f.checker_id == "RF005"]
+    assert any("python `if`" in m for m in msgs)
+    assert any("host sync `float" in m for m in msgs)
+    assert any("host sync `np.asarray" in m for m in msgs)
+    assert any("inside a loop" in m for m in msgs)
+
+
+def test_rf005_quiet_on_device_side_idioms(tmp_path):
+    r = _analyze_snippet(tmp_path, RF005_GOOD)
+    assert "RF005" not in _ids(r)
+
+
+def test_rf005_ops_train_is_clean():
+    r = analyze_paths([os.path.join(REPO, "rafiki_tpu/ops"),
+                       os.path.join(REPO, "rafiki_tpu/parallel")],
+                      select=["RF005"])
+    assert r.unsuppressed == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions / cli / misc
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_with_justification_suppresses(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        def gate(platform):
+            # lint: disable=RF002 — exercised by the suppression test
+            return platform == "tpu"
+        """)
+    assert r.unsuppressed == []
+    assert len(r.findings) == 1 and r.findings[0].suppressed
+    assert "suppression test" in r.findings[0].justification
+
+
+def test_suppression_without_justification_does_not_suppress(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        def gate(platform):
+            return platform == "tpu"  # lint: disable=RF002
+        """)
+    assert len(r.unsuppressed) == 1
+    assert "no justification" in r.unsuppressed[0].message
+
+
+def test_suppression_only_covers_named_ids(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        def gate(platform):
+            # lint: disable=RF005 — wrong id on purpose
+            return platform == "tpu"
+        """)
+    assert [f.checker_id for f in r.unsuppressed] == ["RF002"]
+
+
+def test_select_runs_only_requested_checkers(tmp_path):
+    f = tmp_path / "both.py"
+    f.write_text('import jax\n\ndef main():\n    return jax.devices()\n'
+                 '\nx = "x" == "tpu"\n')
+    r = analyze_paths([str(f)], select=["RF002"])
+    assert _ids(r) == ["RF002"]
+
+
+def test_module_name_for_package_files():
+    assert module_name_for(
+        os.path.join(REPO, "rafiki_tpu/bus/queues.py")) == "rafiki_tpu.bus.queues"
+    assert module_name_for(os.path.join(REPO, "bench.py")) == "bench"
+
+
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    import json as _json
+
+    from rafiki_tpu.analysis.cli import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text('def gate(p):\n    return p == "tpu"\n')
+    assert main([str(bad), "--format", "json"]) == 1
+    payload = _json.loads(capsys.readouterr().out)
+    assert payload["unsuppressed"] == 1
+    assert payload["findings"][0]["checker"] == "RF002"
+
+    good = tmp_path / "good.py"
+    good.write_text('def gate(p):\n    return p != "cpu"\n')
+    assert main([str(good), "--format", "json"]) == 0
+
+    assert main([str(good), "--select", "NOPE01"]) == 2

@@ -116,3 +116,64 @@ def test_process_job_stop_event(env):
     assert not th.is_alive()
     assert out["result"].status == "STOPPED"
     assert len(out["result"].trials) < 500
+
+
+# ---------------------------------------------------------------------------
+# Group liveness: a follower exiting rc=0 mid-trial (round-4 ADVICE d)
+# ---------------------------------------------------------------------------
+
+
+class _StubProc:
+    """poll()-only stand-in for a subprocess.Popen in _WorkerGroup."""
+
+    def __init__(self, rc):
+        self._rc = rc
+
+    def poll(self):
+        return self._rc
+
+
+def _group(*rcs):
+    from rafiki_tpu.scheduler.process import _WorkerGroup
+
+    g = _WorkerGroup(0)
+    g.procs = [_StubProc(rc) for rc in rcs]
+    return g
+
+
+def test_follower_rc0_midtrial_fails_group_after_grace(monkeypatch):
+    """The wedge: follower gone rc=0, leader alive. The group must go
+    'failed' once the grace window elapses — not sit 'running' until
+    the collective transport timeout minutes later."""
+    monkeypatch.setenv("RAFIKI_FOLLOWER_EXIT_GRACE_S", "0.2")
+    g = _group(None, 0)  # leader alive, follower exited clean
+    assert g.state() == "running"  # first observation arms the clock
+    assert g.partial_exit_at is not None
+    time.sleep(0.3)
+    assert g.state() == "failed"
+
+
+def test_follower_rc0_within_grace_stays_running(monkeypatch):
+    monkeypatch.setenv("RAFIKI_FOLLOWER_EXIT_GRACE_S", "30")
+    g = _group(None, 0)
+    assert g.state() == "running"
+    assert g.state() == "running"  # second poll inside grace: still up
+
+
+def test_clean_group_exit_is_ok_not_failed():
+    g = _group(0, 0)
+    assert g.state() == "ok"
+    assert g.partial_exit_at is None
+
+
+def test_follower_nonzero_exit_fails_immediately():
+    g = _group(None, 1)  # crash path keeps its zero-delay behavior
+    assert g.state() == "failed"
+
+
+def test_leader_clean_exit_with_follower_draining_stays_running():
+    # Leader done (budget drained), follower still flushing: normal
+    # shutdown tail, must NOT arm the partial-exit clock.
+    g = _group(0, None)
+    assert g.state() == "running"
+    assert g.partial_exit_at is None

@@ -70,6 +70,12 @@ def served(tmp_path_factory):
         p.start()
     deadline = time.monotonic() + 120
     while len(bus.get_workers(JOB)) < 2:
+        # Fail FAST on a dead child instead of burning the whole
+        # registration deadline: the round-5 regression (spawn target
+        # missing honor_env_platform, child hung/died in backend init)
+        # cost 120s per run before reporting anything.
+        dead = [(p.name, p.exitcode) for p in procs if not p.is_alive()]
+        assert not dead, f"worker process died before registering: {dead}"
         assert time.monotonic() < deadline, "workers never registered"
         time.sleep(0.05)
     yield bus, procs
