@@ -252,7 +252,9 @@ class Admin:
 
     def create_inference_job(self, user_id: Optional[str], app: str,
                              app_version: int = -1,
-                             max_models: int = 2) -> Dict[str, Any]:
+                             max_models: int = 2,
+                             gateway: Optional[Dict[str, Any]] = None
+                             ) -> Dict[str, Any]:
         job = self.store.get_train_job_by_app(app, app_version, user_id)
         if job is None:
             raise NotFoundError(f"No train job for app {app!r}")
@@ -269,7 +271,8 @@ class Admin:
                 raise ValueError(f"No completed trials for app {app!r}")
             inf = self.store.create_inference_job(job["id"], user_id)
             try:
-                self.services.create_inference_services(inf["id"], best)
+                self.services.create_inference_services(
+                    inf["id"], best, gateway_overrides=gateway)
             except Exception:
                 self.store.update_inference_job(inf["id"],
                                                 status=InferenceJobStatus.ERRORED.value)
@@ -295,13 +298,15 @@ class Admin:
     def predict(self, app: str, queries: List[Any],
                 app_version: int = -1) -> List[Any]:
         """Route queries to the app's live predictor (in-proc path; the
-        HTTP path hits the predictor app directly)."""
+        HTTP path hits the predictor app directly). Goes through the
+        serving gateway so the in-proc path gets the same admission
+        control and quorum gather as external HTTP traffic."""
         inf = self.get_inference_job(app, app_version)
-        predictor = self.services.get_predictor(inf["id"])
-        if predictor is None:
+        gateway = self.services.get_gateway(inf["id"])
+        if gateway is None:
             raise RuntimeError(f"Inference job {inf['id']} has no live predictor "
                                "in this process")
-        return predictor.predict(queries)
+        return gateway.predict(queries)
 
     # -- recovery ------------------------------------------------------------
 

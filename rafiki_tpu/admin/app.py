@@ -290,10 +290,15 @@ class AdminApp:
     def ep_create_inference_job(self, request: Request) -> Response:
         user = self._auth(request, [UserType.APP_DEVELOPER.value])
         body = self._body(request)
+        gateway = body.get("gateway")
+        if gateway is not None and not isinstance(gateway, dict):
+            raise ValueError("gateway must be an object of gateway-config "
+                             "overrides (e.g. {\"policy\": \"least-loaded\"})")
         return _json(self.admin.create_inference_job(
             self._scope(user), self._field(body, "app"),
             int(body.get("app_version", -1)),
-            max_models=int(body.get("max_models", 2))), 201)
+            max_models=int(body.get("max_models", 2)),
+            gateway=gateway), 201)
 
     def ep_get_inference_job(self, request: Request, app: str,
                              app_version: int = -1) -> Response:

@@ -33,6 +33,7 @@ from rafiki_tpu.constants import (
     ServiceType,
     TrainJobStatus,
 )
+from rafiki_tpu.gateway import Gateway, GatewayConfig
 from rafiki_tpu.model.base import load_model_class
 from rafiki_tpu.predictor.predictor import Predictor
 from rafiki_tpu.scheduler.local import LocalScheduler
@@ -55,6 +56,7 @@ class _InferenceJobHandle:
         self.worker_threads: List[threading.Thread] = []
         self.workers: List[InferenceWorker] = []
         self.predictor: Optional[Predictor] = None
+        self.gateway: Optional[Gateway] = None
         self.http_server = None  # set when an HTTP frontend is attached
 
 
@@ -144,18 +146,26 @@ class ServicesManager:
     def create_inference_services(self, inference_job_id: str,
                                   best_trials: List[dict],
                                   batch_size: Optional[int] = None,
-                                  serve_http: bool = True) -> Predictor:
-        """One inference worker per trial + a predictor over the bus,
-        plus (by default) a published HTTP frontend whose host:port is
-        recorded on the inference-job row — the reference's per-job
-        predictor port."""
+                                  serve_http: bool = True,
+                                  gateway_overrides: Optional[Dict[str, Any]]
+                                  = None) -> Predictor:
+        """One inference worker per trial + a predictor over the bus
+        fronted by a serving Gateway (admission control, quorum
+        fan-out, breakers — docs/serving.md), plus (by default) a
+        published HTTP frontend whose host:port is recorded on the
+        inference-job row — the reference's per-job predictor port.
+
+        ``gateway_overrides`` lets a job pick its own routing policy
+        and limits (e.g. ``{"policy": "least-loaded",
+        "max_inflight": 4}``) over the framework-config defaults."""
         if not best_trials:
             raise ValueError("No completed trials to serve")
         handle = _InferenceJobHandle()
         batch_size = batch_size or self.config.inference_batch_size
         try:
             return self._start_inference(handle, inference_job_id, best_trials,
-                                         batch_size, serve_http)
+                                         batch_size, serve_http,
+                                         gateway_overrides or {})
         except Exception:
             # Tear down whatever already started — otherwise worker
             # threads (each pinning a trained model) leak unreachably.
@@ -170,7 +180,8 @@ class ServicesManager:
 
     def _start_inference(self, handle: "_InferenceJobHandle",
                          inference_job_id: str, best_trials: List[dict],
-                         batch_size: int, serve_http: bool) -> Predictor:
+                         batch_size: int, serve_http: bool,
+                         gateway_overrides: Dict[str, Any]) -> Predictor:
         models = [self._load_trial_model(t) for t in best_trials]
 
         # Same-architecture top-k → ONE worker running a stacked vmapped
@@ -201,6 +212,9 @@ class ServicesManager:
         self.store.create_service(ServiceType.PREDICTOR.value, job_id=inference_job_id)
         handle.predictor = Predictor(self.bus, inference_job_id,
                                      timeout_s=self.config.predict_timeout_s)
+        handle.gateway = Gateway(handle.predictor,
+                                 GatewayConfig.from_config(
+                                     self.config, **gateway_overrides))
         for th in handle.worker_threads:
             th.start()
         # Wait for workers to register so the first query doesn't race them.
@@ -215,7 +229,7 @@ class ServicesManager:
             from rafiki_tpu.predictor.app import start_predictor_server
 
             handle.http_server, predictor_host = start_predictor_server(
-                handle.predictor, host=self.config.admin_host)
+                handle.gateway, host=self.config.admin_host)
             # A wildcard bind address is unroutable for clients: advertise
             # a reachable address instead.
             bind_host, _, port = predictor_host.rpartition(":")
@@ -261,6 +275,11 @@ class ServicesManager:
             handle = self._inference_jobs.get(inference_job_id)
         return handle.predictor if handle else None
 
+    def get_gateway(self, inference_job_id: str) -> Optional[Gateway]:
+        with self._lock:
+            handle = self._inference_jobs.get(inference_job_id)
+        return handle.gateway if handle else None
+
     def attach_http_server(self, inference_job_id: str, server) -> None:
         with self._lock:
             handle = self._inference_jobs.get(inference_job_id)
@@ -275,6 +294,10 @@ class ServicesManager:
             self.store.update_inference_job(inference_job_id,
                                             status=InferenceJobStatus.STOPPED.value)
             return
+        if handle.gateway is not None:
+            # Graceful drain BEFORE the workers stop: in-flight requests
+            # finish against live workers; new arrivals shed immediately.
+            handle.gateway.drain(timeout=min(timeout, 5.0))
         handle.stop_event.set()
         for th in handle.worker_threads:
             th.join(timeout=timeout)

@@ -44,6 +44,15 @@ class InProcBus:
         # workers — otherwise repeated inference-job cycles would leak
         # one queue per retired worker id.
         self._queues: Dict[str, queue.Queue] = {}
+        # Running total of enqueued-not-yet-popped queries. add_query
+        # used to recompute it by summing qsize() over EVERY worker
+        # queue under the bus lock — O(workers) on the hot path. The
+        # counter can drift slightly (pop_queries drains outside the
+        # lock, so a concurrent remove_worker may double-subtract);
+        # it feeds a gauge and the least-loaded router, both of which
+        # tolerate approximation, so we clamp at 0 rather than pay a
+        # stricter protocol.
+        self._depth = 0
         self._preds: Dict[str, list] = {}
         self._pred_cv = threading.Condition()
         # Plain dict, NOT defaultdict: read paths (heartbeat of a
@@ -68,7 +77,9 @@ class InProcBus:
         with self._lock:
             self._workers.get(job_id, set()).discard(worker_id)
             self._worker_ts.pop((job_id, worker_id), None)
-            self._queues.pop(worker_id, None)
+            q = self._queues.pop(worker_id, None)
+            if q is not None:  # pending queries die with the queue
+                self._depth = max(0, self._depth - q.qsize())
 
     def heartbeat(self, job_id: str, worker_id: str) -> None:
         with self._lock:
@@ -105,7 +116,9 @@ class InProcBus:
                           if self._worker_ts.get((j, w), 0.0) < cutoff]:
                     ws.discard(w)
                     self._worker_ts.pop((j, w), None)
-                    self._queues.pop(w, None)
+                    q = self._queues.pop(w, None)
+                    if q is not None:
+                        self._depth = max(0, self._depth - q.qsize())
                     reaped.append((j, w))
         if reaped:
             telemetry.inc("bus.reaped_workers", len(reaped))
@@ -116,13 +129,22 @@ class InProcBus:
     def add_query(self, worker_id: str, query_id: str, query: Any) -> None:
         with self._lock:
             q = self._queues.get(worker_id)
-            depth = sum(qq.qsize() for qq in self._queues.values())
+            if q is not None:
+                q.put((query_id, query))  # unbounded Queue: put never blocks
+                self._depth += 1
+                depth = self._depth
         if q is not None:  # dead worker → drop; the gather just sees n-1
-            q.put((query_id, query))
             telemetry.inc("bus.queries_added")
-            telemetry.set_gauge("bus.queue_depth", depth + 1)
+            telemetry.set_gauge("bus.queue_depth", depth)
         else:
             telemetry.inc("bus.queries_dropped_dead_worker")
+
+    def queue_depth(self, worker_id: str) -> int:
+        """Pending (unpopped) queries for one worker — the signal the
+        gateway's least-loaded router keys on."""
+        with self._lock:
+            q = self._queues.get(worker_id)
+            return q.qsize() if q is not None else 0
 
     def pop_queries(self, worker_id: str, max_n: int = 64,
                     timeout: float = 0.1) -> List[Tuple[str, Any]]:
@@ -143,6 +165,8 @@ class InProcBus:
                 out.append(q.get_nowait())
             except queue.Empty:
                 break
+        with self._lock:
+            self._depth = max(0, self._depth - len(out))
         telemetry.inc("bus.queries_popped", len(out))
         telemetry.observe("bus.pop_batch_size", len(out))
         return out
@@ -157,16 +181,36 @@ class InProcBus:
             self._pred_cv.notify_all()
 
     def get_predictions(self, query_id: str, n: int,
-                        timeout: float = 10.0) -> List[Tuple[str, Any]]:
+                        timeout: float = 10.0,
+                        min_n: Optional[int] = None,
+                        grace_s: Optional[float] = None) -> List[Tuple[str, Any]]:
         """Wait until n predictions arrived (or timeout); pops the slot.
-        After this returns, late answers for query_id are discarded."""
+        After this returns, late answers for query_id are discarded.
+
+        Quorum gather: with ``min_n`` (and optionally ``grace_s``), the
+        wait relaxes once ``min_n`` replies are in — from that moment
+        at most ``grace_s`` more seconds are granted for stragglers
+        before the partial set is returned. This is how the gateway
+        keeps p99 tracking the median replica instead of the slowest.
+        """
         deadline = time.monotonic() + timeout
+        quorum = n if min_n is None else max(1, min(min_n, n))
+        quorum_at: Optional[float] = None
         with self._pred_cv:
-            while len(self._preds.get(query_id, [])) < n:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
+            while True:
+                got = len(self._preds.get(query_id, []))
+                if got >= n:
                     break
-                self._pred_cv.wait(remaining)
+                now = time.monotonic()
+                limit = deadline
+                if got >= quorum:
+                    if quorum_at is None:
+                        quorum_at = now
+                    if grace_s is not None:
+                        limit = min(limit, quorum_at + grace_s)
+                if now >= limit:
+                    break
+                self._pred_cv.wait(limit - now)
             if len(self._expired) == self._expired.maxlen:
                 self._expired_set.discard(self._expired[0])
             self._expired.append(query_id)
@@ -201,6 +245,8 @@ class _MpBus:
     blocking Queue.get costs nothing extra at this bus's scale.
     """
 
+    _EXPIRED_CAP = 4096  # remembered gathered/timed-out query ids
+
     def __init__(self, manager):
         self._manager = manager         # keepalive only; dropped on pickle
         self._queues = manager.dict()   # worker_id -> tuple of (qid, query)
@@ -208,6 +254,7 @@ class _MpBus:
         self._workers = manager.dict()  # job_id -> tuple of worker ids
         self._worker_ts = manager.dict()  # "job|worker" -> epoch seconds
         self._expired = manager.dict()  # gathered/timed-out query ids
+        self._expired_cap = self._EXPIRED_CAP  # instance-level for tests
         self._lock = manager.Lock()
 
     def __getstate__(self):
@@ -279,6 +326,11 @@ class _MpBus:
                 return
             self._queues[worker_id] = pending + ((query_id, query),)
 
+    def queue_depth(self, worker_id):
+        """Pending (unpopped) queries for one worker (least-loaded
+        routing signal). One proxy read; no lock needed for a gauge."""
+        return len(self._queues.get(worker_id, ()))
+
     def pop_queries(self, worker_id, max_n=64, timeout=0.1):
         deadline = time.monotonic() + timeout
         while True:
@@ -301,16 +353,37 @@ class _MpBus:
             self._preds[query_id] = (self._preds.get(query_id, ())
                                      + ((worker_id, prediction),))
 
-    def get_predictions(self, query_id, n, timeout=10.0):
+    def get_predictions(self, query_id, n, timeout=10.0, min_n=None,
+                        grace_s=None):
+        """Same contract as InProcBus.get_predictions, including the
+        quorum/hedge relaxation, over polling instead of a condvar."""
         deadline = time.monotonic() + timeout
+        quorum = n if min_n is None else max(1, min(min_n, n))
+        quorum_at = None
         while True:
             preds = self._preds.get(query_id, ())
-            if len(preds) >= n or time.monotonic() >= deadline:
+            now = time.monotonic()
+            if len(preds) >= n:
+                break
+            limit = deadline
+            if len(preds) >= quorum:
+                if quorum_at is None:
+                    quorum_at = now
+                if grace_s is not None:
+                    limit = min(limit, quorum_at + grace_s)
+            if now >= limit:
                 break
             time.sleep(0.005)
         with self._lock:
             preds = self._preds.pop(query_id, ())
             self._expired[query_id] = True
-            if len(self._expired) > 4096:
-                self._expired.clear()  # coarse cap; stale ids just re-leak one slot
+            overflow = len(self._expired) - self._expired_cap
+            if overflow > 0:
+                # Insertion-ordered trim (manager dicts keep insert
+                # order), mirroring InProcBus's deque+set pair. The old
+                # coarse `.clear()` forgot EVERY expired id at once,
+                # reopening the late-answer leak for all inflight
+                # gathers the moment the cap was hit.
+                for old in list(self._expired.keys())[:overflow]:
+                    del self._expired[old]
         return list(preds)

@@ -163,9 +163,15 @@ class Client:
     # -- inference jobs ------------------------------------------------------
 
     def create_inference_job(self, app: str, app_version: int = -1,
-                             max_models: int = 2) -> dict:
-        return self._post("/inference_jobs", {"app": app, "app_version": app_version,
-                                              "max_models": max_models})
+                             max_models: int = 2,
+                             gateway: Optional[dict] = None) -> dict:
+        """``gateway`` carries per-job serving-gateway overrides —
+        routing policy and admission limits (docs/serving.md)."""
+        body = {"app": app, "app_version": app_version,
+                "max_models": max_models}
+        if gateway is not None:
+            body["gateway"] = gateway
+        return self._post("/inference_jobs", body)
 
     def get_inference_job(self, app: str, app_version: int = -1) -> dict:
         return self._get(self._vpath("/inference_jobs", app, app_version))

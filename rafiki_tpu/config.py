@@ -49,6 +49,15 @@ class Config:
     predict_timeout_s: float = 10.0
     inference_batch_size: int = 64
 
+    # Serving gateway (rafiki_tpu/gateway/; see docs/serving.md)
+    gateway_max_inflight: int = 8
+    gateway_max_queue: int = 32
+    gateway_hedge_grace_s: float = 0.25
+    gateway_policy: str = "replicate-all"
+    gateway_breaker_failures: int = 3
+    gateway_breaker_cooldown_s: float = 5.0
+    max_queries_per_request: int = 1024
+
     # Compute
     default_dtype: str = "bfloat16"
     # Storage dtype for serving params blobs (dump_parameters). The

@@ -1,0 +1,15 @@
+"""Serving gateway: the frontend layer between the predictor HTTP app
+and the bus — admission control with per-request deadlines, quorum
+fan-out with hedged stragglers, per-worker circuit breakers, routing
+policies, and graceful drain. See docs/serving.md.
+"""
+
+from rafiki_tpu.gateway.admission import AdmissionController, ShedError
+from rafiki_tpu.gateway.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from rafiki_tpu.gateway.gateway import POLICIES, Gateway, GatewayConfig
+
+__all__ = [
+    "AdmissionController", "ShedError",
+    "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
+    "Gateway", "GatewayConfig", "POLICIES",
+]

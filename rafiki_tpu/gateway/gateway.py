@@ -1,0 +1,272 @@
+"""The serving gateway: admission control, deadline-aware routing and
+quorum fan-out between the HTTP frontend and the bus.
+
+The predict path used to be ``PredictorApp → Predictor.predict``
+directly: unbounded concurrency, wait-for-all gathers, and fan-out to
+every registered worker until its lease expired. The gateway is the
+layer TPU serving stacks treat as table stakes:
+
+  * **admission control** — bounded inflight budget + bounded wait
+    queue with per-request deadlines; overflow is shed *immediately*
+    (HTTP 429 + Retry-After upstream) instead of queuing forever;
+  * **deadline-aware quorum gather** — fan out, wait for
+    ``min_replies`` (default ceil(k/2)), grant stragglers a short
+    hedge grace, ensemble what arrived: p99 tracks the median replica;
+  * **per-worker circuit breakers** — consecutive zero-reply batches
+    open a worker's breaker and it stops receiving fan-out *before*
+    its heartbeat lease expires;
+  * **routing policies** — ``replicate-all`` (ensemble, the default)
+    or ``least-loaded`` (single replica by bus queue depth, for
+    throughput-mode jobs);
+  * **graceful drain** — stop admitting, flush inflight, flip
+    ``/healthz`` to draining.
+
+One Gateway fronts one inference job's Predictor. All counters flow
+through both gateway-local stats (``GET /gateway``) and the global
+telemetry registry (``GET /metrics``), registered as the ``gateway``
+collector so breaker state shows up in every snapshot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import threading
+
+from rafiki_tpu import telemetry
+from rafiki_tpu.gateway.admission import AdmissionController, ShedError
+from rafiki_tpu.gateway.breaker import CircuitBreaker
+
+POLICIES = ("replicate-all", "least-loaded")
+
+
+@dataclasses.dataclass
+class GatewayConfig:
+    max_inflight: int = 8           # concurrent predict batches
+    max_queue: int = 32             # waiters beyond the inflight budget
+    default_deadline_s: Optional[float] = None  # None → predictor.timeout_s
+    min_replies: Optional[int] = None  # gather quorum; None → ceil(k/2)
+    hedge_grace_s: float = 0.25     # straggler grace once quorum arrived
+    policy: str = "replicate-all"
+    breaker_failures: int = 3       # consecutive misses before opening
+    breaker_cooldown_s: float = 5.0
+    max_queries_per_request: int = 1024  # HTTP app: 413 above this
+
+    def __post_init__(self):
+        if self.policy not in POLICIES:
+            raise ValueError(
+                f"unknown routing policy {self.policy!r}; one of {POLICIES}")
+
+    @classmethod
+    def from_config(cls, cfg, **overrides) -> "GatewayConfig":
+        """Build from the framework Config (rafiki_tpu/config.py),
+        with per-job overrides on top (services manager plumbing)."""
+        base = dict(
+            max_inflight=cfg.gateway_max_inflight,
+            max_queue=cfg.gateway_max_queue,
+            default_deadline_s=cfg.predict_timeout_s,
+            hedge_grace_s=cfg.gateway_hedge_grace_s,
+            policy=cfg.gateway_policy,
+            breaker_failures=cfg.gateway_breaker_failures,
+            breaker_cooldown_s=cfg.gateway_breaker_cooldown_s,
+            max_queries_per_request=cfg.max_queries_per_request,
+        )
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(overrides) - known
+        if unknown:
+            raise ValueError(f"unknown gateway config keys: {sorted(unknown)}")
+        base.update(overrides)
+        return cls(**base)
+
+
+class Gateway:
+    """Serving frontend for one inference job's predictor."""
+
+    def __init__(self, predictor, config: Optional[GatewayConfig] = None):
+        self.predictor = predictor
+        self.cfg = config or GatewayConfig()
+        self.admission = AdmissionController(self.cfg.max_inflight,
+                                             self.cfg.max_queue)
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._draining = False
+        # Gateway-local counters: the numbers `GET /gateway` serves.
+        # The same events also flow into the global telemetry registry
+        # so `/metrics` agrees with them (acceptance criterion c).
+        self._admitted = 0
+        self._shed: Dict[str, int] = {}
+        self._hedged = 0
+        self._timeouts = 0
+        self._latency_ewma_s: Optional[float] = None
+        # Latest gateway wins the collector slot: one predictor process
+        # serves one job, and tests that build several gateways only
+        # ever assert on the live one.
+        telemetry.register_collector("gateway", self.stats)
+
+    # -- the predict path ----------------------------------------------------
+
+    def predict(self, queries: List[Any],
+                deadline_s: Optional[float] = None) -> List[Any]:
+        """Admit → route → quorum-gather → feed breakers. Raises
+        :class:`ShedError` when admission refuses, RuntimeError when
+        the job has no live workers."""
+        deadline_s = (deadline_s or self.cfg.default_deadline_s
+                      or self.predictor.timeout_s)
+        deadline = time.monotonic() + deadline_s
+        with self._lock:
+            draining = self._draining
+        if draining:
+            self._count_shed("draining")
+            raise ShedError("draining", self._retry_after())
+        # Deadline-aware admission: don't hold a waiter past the point
+        # where the expected service time no longer fits its deadline —
+        # shedding NOW beats admitting a request doomed to time out.
+        reserve = min(self._expected_service_s(), deadline_s * 0.5)
+        try:
+            waited = self.admission.admit(deadline - reserve,
+                                          retry_after_s=self._retry_after())
+        except ShedError as e:
+            self._count_shed(e.reason)
+            raise
+        with self._lock:
+            self._admitted += 1
+        telemetry.inc("gateway.admitted")
+        if waited:
+            telemetry.observe("gateway.queue_wait_s", waited)
+        t0 = time.monotonic()
+        try:
+            workers, quorum = self._route()
+            report = self.predictor.predict_detailed(
+                queries, workers=workers,
+                timeout_s=max(0.0, deadline - time.monotonic()),
+                min_replies=quorum,
+                hedge_grace_s=self.cfg.hedge_grace_s)
+        finally:
+            self.admission.release()
+        self._absorb(report, time.monotonic() - t0)
+        return report.outputs
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self) -> Tuple[List[str], int]:
+        """Pick the fan-out set (breaker-filtered) and gather quorum."""
+        workers = self.predictor.live_workers()
+        allowed = [w for w in workers if self._breaker(w).allow()]
+        if not allowed:
+            # Every breaker open/probing: routing nowhere would turn a
+            # brown-out into a black-out. Fan out to the full live set
+            # as a forced probe instead.
+            allowed = workers
+        if self.cfg.policy == "least-loaded" and allowed:
+            depth_of = getattr(self.predictor.bus, "queue_depth", None)
+            if depth_of is not None:
+                allowed = [min(allowed, key=depth_of)]
+            else:  # bus without depth support: fall back to first
+                allowed = allowed[:1]
+            return allowed, 1
+        quorum = (self.cfg.min_replies if self.cfg.min_replies is not None
+                  else max(1, math.ceil(len(allowed) / 2)))
+        return allowed, quorum
+
+    def _breaker(self, worker_id: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(worker_id)
+            if br is None:
+                br = self._breakers[worker_id] = CircuitBreaker(
+                    self.cfg.breaker_failures, self.cfg.breaker_cooldown_s)
+            return br
+
+    def _absorb(self, report, elapsed_s: float) -> None:
+        """Feed one batch's gather report into breakers and stats."""
+        n_queries = len(report.outputs)
+        for w in report.workers:
+            br = self._breaker(w)
+            if report.replies.get(w, 0) > 0:
+                br.record_success(latency_s=elapsed_s)
+            else:
+                br.record_failure()
+        with self._lock:
+            self._hedged += report.hedged
+            self._timeouts += report.timeouts
+            if report.timeouts == 0 and n_queries:
+                prev = self._latency_ewma_s
+                self._latency_ewma_s = (elapsed_s if prev is None
+                                        else 0.8 * prev + 0.2 * elapsed_s)
+        if report.hedged:
+            telemetry.inc("gateway.hedged", report.hedged)
+
+    # -- deadline bookkeeping ------------------------------------------------
+
+    def _expected_service_s(self) -> float:
+        with self._lock:
+            return self._latency_ewma_s or 0.0
+
+    def _retry_after(self) -> float:
+        """Back-off hint: roughly one queue-drain time at current
+        service latency, floored so clients never spin."""
+        with self._lock:
+            ewma = self._latency_ewma_s or 0.1
+        backlog = self.admission.waiting + 1
+        return round(max(0.1, ewma * backlog / self.cfg.max_inflight), 3)
+
+    def _count_shed(self, reason: str) -> None:
+        with self._lock:
+            self._shed[reason] = self._shed.get(reason, 0) + 1
+        telemetry.inc("gateway.shed")
+        telemetry.inc(f"gateway.shed_{reason}")
+
+    # -- drain ---------------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def drain(self, timeout: Optional[float] = 10.0) -> bool:
+        """Stop admitting (new requests and queued waiters shed with
+        reason ``draining``), then flush inflight requests. Returns
+        True when everything inflight finished within ``timeout``.
+        ``/healthz`` reports draining from the first moment."""
+        with self._lock:
+            already = self._draining
+            self._draining = True
+        if not already:
+            telemetry.inc("gateway.drains")
+        self.admission.close()
+        return self.admission.wait_idle(timeout)
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """JSON-able state for ``GET /gateway`` and the telemetry
+        collector: admission counters, routing config, breaker state."""
+        with self._lock:
+            shed = dict(self._shed)
+            out: Dict[str, Any] = {
+                "policy": self.cfg.policy,
+                "draining": self._draining,
+                "admitted": self._admitted,
+                "shed": shed,
+                "shed_total": sum(shed.values()),
+                "hedged": self._hedged,
+                "timeouts": self._timeouts,
+                "latency_ewma_s": (None if self._latency_ewma_s is None
+                                   else round(self._latency_ewma_s, 6)),
+                "limits": {
+                    "max_inflight": self.cfg.max_inflight,
+                    "max_queue": self.cfg.max_queue,
+                    "default_deadline_s": self.cfg.default_deadline_s,
+                    "hedge_grace_s": self.cfg.hedge_grace_s,
+                    "min_replies": self.cfg.min_replies,
+                    "max_queries_per_request":
+                        self.cfg.max_queries_per_request,
+                },
+                "breakers": {w: b.snapshot()
+                             for w, b in self._breakers.items()},
+            }
+        out["inflight"] = self.admission.inflight
+        out["waiting"] = self.admission.waiting
+        return out

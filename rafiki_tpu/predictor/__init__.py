@@ -8,6 +8,7 @@ math are importable without any server.
 """
 
 from rafiki_tpu.predictor.ensemble import ensemble_predictions
-from rafiki_tpu.predictor.predictor import Predictor
+from rafiki_tpu.predictor.predictor import GatherReport, Predictor, default_quorum
 
-__all__ = ["Predictor", "ensemble_predictions"]
+__all__ = ["GatherReport", "Predictor", "default_quorum",
+           "ensemble_predictions"]

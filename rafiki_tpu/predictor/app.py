@@ -6,52 +6,105 @@ clients POST queries there and get ensembled predictions. The
 services manager starts one of these per inference job (loopback by
 default; bind 0.0.0.0 for external traffic) and records host:port in
 the inference-job row so clients can discover it.
+
+The app no longer talks to the Predictor directly: every request goes
+through the serving Gateway (rafiki_tpu/gateway/), which owns
+admission control, deadlines, quorum fan-out, circuit breakers and
+drain. Status mapping:
+
+  200  admitted and answered
+  400  malformed body (not JSON / queries not a list)
+  413  more queries than ``max_queries_per_request``
+  429  shed by admission control (``Retry-After`` header set)
+  503  no live workers, or gateway draining (``Retry-After`` set)
 """
 
 from __future__ import annotations
 
 import json
-from typing import Any, Optional
+import math
+from typing import Any, Union
 
 from werkzeug.wrappers import Request, Response
 
+from rafiki_tpu.gateway import Gateway, ShedError
 from rafiki_tpu.predictor.predictor import Predictor
 from rafiki_tpu.utils.jsonable import jsonable as _jsonable
 
 
 class PredictorApp:
-    """WSGI app: POST /predict {"queries": [...]}, GET /healthz,
+    """WSGI app: POST /predict {"queries": [...], "deadline_s"?: float},
+    GET /healthz (503 + draining while the gateway drains),
+    GET /gateway (admission/breaker/routing stats),
+    POST /drain (stop admitting, flush inflight),
     GET /metrics (read-only telemetry snapshot — spans, counters,
     queue-depth gauges, gather-latency histograms of THIS process)."""
 
-    def __init__(self, predictor: Predictor):
-        self.predictor = predictor
+    def __init__(self, target: Union[Gateway, Predictor]):
+        # Accept a bare Predictor for back-compat with direct callers
+        # (tests, notebooks): it gets a default-config Gateway.
+        self.gateway = target if isinstance(target, Gateway) else Gateway(target)
+        self.predictor = self.gateway.predictor
 
     def __call__(self, environ, start_response):
         request = Request(environ)
         try:
             if request.path == "/healthz" and request.method == "GET":
-                response = self._json({"status": "ok"})
+                if self.gateway.draining:
+                    response = self._json({"status": "draining"}, 503)
+                else:
+                    response = self._json({"status": "ok"})
             elif request.path == "/metrics" and request.method == "GET":
                 from rafiki_tpu import telemetry
 
                 response = self._json(telemetry.snapshot())
+            elif request.path == "/gateway" and request.method == "GET":
+                response = self._json(self.gateway.stats())
+            elif request.path == "/drain" and request.method == "POST":
+                flushed = self.gateway.drain()
+                response = self._json({"status": "draining",
+                                       "flushed": flushed})
             elif request.path == "/predict" and request.method == "POST":
-                body = request.get_json(force=True, silent=True) or {}
-                queries = body.get("queries")
-                if not isinstance(queries, list):
-                    response = self._json(
-                        {"error": "Body must be {\"queries\": [...]}"}, 400)
-                else:
-                    preds = self.predictor.predict(queries)
-                    response = self._json({"predictions": _jsonable(preds)})
+                response = self._predict(request)
             else:
                 response = self._json({"error": "Not found"}, 404)
+        except ShedError as e:
+            status = 503 if e.reason == "draining" else 429
+            response = self._json({"error": str(e), "reason": e.reason},
+                                  status)
+            response.headers["Retry-After"] = str(
+                max(1, math.ceil(e.retry_after_s)))
         except RuntimeError as e:  # e.g. no live workers
             response = self._json({"error": str(e)}, 503)
         except Exception as e:
             response = self._json({"error": f"{type(e).__name__}: {e}"}, 500)
         return response(environ, start_response)
+
+    def _predict(self, request: Request) -> Response:
+        body = request.get_json(force=True, silent=True)
+        if not isinstance(body, dict):
+            return self._json(
+                {"error": "Body must be {\"queries\": [...]}"}, 400)
+        queries = body.get("queries")
+        if not isinstance(queries, list):
+            return self._json(
+                {"error": "Body must be {\"queries\": [...]}"}, 400)
+        cap = self.gateway.cfg.max_queries_per_request
+        if len(queries) > cap:
+            return self._json(
+                {"error": f"{len(queries)} queries exceeds the "
+                          f"per-request limit of {cap}"}, 413)
+        deadline_s = body.get("deadline_s")
+        if deadline_s is not None:
+            try:
+                deadline_s = float(deadline_s)
+            except (TypeError, ValueError):
+                return self._json({"error": "deadline_s must be a number"},
+                                  400)
+            if deadline_s <= 0:
+                return self._json({"error": "deadline_s must be > 0"}, 400)
+        preds = self.gateway.predict(queries, deadline_s=deadline_s)
+        return self._json({"predictions": _jsonable(preds)})
 
     @staticmethod
     def _json(data: Any, status: int = 200) -> Response:
@@ -59,14 +112,15 @@ class PredictorApp:
                         mimetype="application/json")
 
 
-def start_predictor_server(predictor: Predictor, host: str = "127.0.0.1",
-                           port: int = 0):
-    """Serve a predictor in a daemon thread; returns (server, "host:port")."""
+def start_predictor_server(target: Union[Gateway, Predictor],
+                           host: str = "127.0.0.1", port: int = 0):
+    """Serve a gateway (or bare predictor) in a daemon thread; returns
+    (server, "host:port")."""
     import threading
 
     from werkzeug.serving import make_server
 
-    server = make_server(host, port, PredictorApp(predictor), threaded=True)
+    server = make_server(host, port, PredictorApp(target), threaded=True)
     threading.Thread(target=server.serve_forever, name="predictor-http",
                      daemon=True).start()
     return server, f"{host}:{server.server_port}"

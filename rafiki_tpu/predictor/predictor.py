@@ -13,16 +13,43 @@ in telemetry), not as per-query timeout errors that masquerade as slow
 answers. The predictor also runs the bus janitor each batch: leases
 older than ``REAP_TTL_FACTOR×TTL`` are corpses whose registrations get
 deleted outright.
+
+Gather modes: the default is wait-for-all (every fresh-leased replica,
+bounded by the batch deadline). The serving gateway
+(rafiki_tpu/gateway/) instead calls :meth:`predict_detailed` with
+``min_replies`` — a *quorum* gather: once ``min_replies`` replicas
+answered, only a short hedge grace is granted for stragglers before
+ensembling, so batch p99 tracks the median replica rather than the
+slowest. ``predict_detailed`` also reports per-worker reply counts,
+which feed the gateway's circuit breakers.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import math
 import time
 import uuid
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 from rafiki_tpu import telemetry
 from rafiki_tpu.predictor.ensemble import ensemble_predictions
+
+
+@dataclasses.dataclass
+class GatherReport:
+    """Everything the gateway needs to know about one predict batch."""
+
+    outputs: List[Any]              # per-query ensembled predictions
+    workers: List[str]              # the fan-out set actually used
+    quorum: int                     # replies waited for per query
+    replies: Dict[str, int]         # worker -> queries it answered in time
+    timeouts: int                   # queries with ZERO replies by deadline
+    hedged: int                     # queries ensembled before all replied
+    elapsed_s: float                # whole-batch gather wall time
+
+    def ok(self) -> bool:
+        return self.timeouts == 0
 
 
 class Predictor:
@@ -31,7 +58,9 @@ class Predictor:
     REAP_TTL_FACTOR = 4.0
 
     def __init__(self, bus, job_id: str, timeout_s: float = 10.0,
-                 worker_ttl_s: float = 3.0):
+                 worker_ttl_s: float = 3.0,
+                 min_replies: Optional[int] = None,
+                 hedge_grace_s: float = 0.25):
         self.bus = bus
         self.job_id = job_id
         self.timeout_s = timeout_s
@@ -41,17 +70,38 @@ class Predictor:
         # Must comfortably exceed the heartbeat period, not predict
         # latency — the lease stays fresh through a long forward.
         self.worker_ttl_s = worker_ttl_s
+        # Default gather quorum. None → wait for every fanned-out
+        # replica (the conservative standalone default); the gateway
+        # passes an explicit quorum (ceil(k/2) unless configured).
+        self.min_replies = min_replies
+        self.hedge_grace_s = hedge_grace_s
 
-    def predict(self, queries: List[Any]) -> List[Any]:
+    def live_workers(self) -> List[str]:
+        """Reap corpses, then return the fresh-leased worker set."""
+        reap = getattr(self.bus, "reap_stale", None)
+        if reap is not None:
+            reap(self.REAP_TTL_FACTOR * self.worker_ttl_s, job_id=self.job_id)
+        return self.bus.get_workers(self.job_id, max_age_s=self.worker_ttl_s)
+
+    def predict(self, queries: List[Any],
+                timeout_s: Optional[float] = None) -> List[Any]:
         """Fan each query out to all fresh-leased workers; ensemble per
         query. A dead-but-registered worker stops being fanned out to
         (and waited on) within one lease TTL — the ensemble degrades to
         k-1 instead of every batch paying the full gather timeout."""
-        reap = getattr(self.bus, "reap_stale", None)
-        if reap is not None:
-            reap(self.REAP_TTL_FACTOR * self.worker_ttl_s, job_id=self.job_id)
-        workers = self.bus.get_workers(self.job_id,
-                                       max_age_s=self.worker_ttl_s)
+        return self.predict_detailed(queries, timeout_s=timeout_s).outputs
+
+    def predict_detailed(self, queries: List[Any],
+                         workers: Optional[List[str]] = None,
+                         timeout_s: Optional[float] = None,
+                         min_replies: Optional[int] = None,
+                         hedge_grace_s: Optional[float] = None) -> GatherReport:
+        """The full-control entry the gateway uses: an explicit fan-out
+        set (already breaker-filtered), a per-request gather budget,
+        and a reply quorum. Returns per-worker reply counts alongside
+        the ensembled outputs."""
+        if workers is None:
+            workers = self.live_workers()
         if not workers:
             # Every lease is stale (or nothing registered): this job has
             # no serving capacity RIGHT NOW. Fail the batch explicitly —
@@ -60,6 +110,12 @@ class Predictor:
             telemetry.inc("predictor.no_live_workers")
             raise RuntimeError(
                 f"no live inference workers for job {self.job_id}")
+        timeout_s = self.timeout_s if timeout_s is None else timeout_s
+        if min_replies is None:
+            min_replies = self.min_replies
+        quorum = (len(workers) if min_replies is None
+                  else max(1, min(min_replies, len(workers))))
+        grace = self.hedge_grace_s if hedge_grace_s is None else hedge_grace_s
         telemetry.inc("predictor.queries", len(queries))
         telemetry.observe("predictor.fanout_workers", len(workers))
         qids = []
@@ -75,18 +131,40 @@ class Predictor:
         # so batch latency stays bounded by timeout_s regardless of
         # batch size.
         t_gather = time.monotonic()
-        deadline = t_gather + self.timeout_s
+        deadline = t_gather + timeout_s
         out: List[Any] = []
+        replies: Dict[str, int] = {}
         timeouts = 0
+        hedged = 0
         for qid in qids:
             remaining = max(0.0, deadline - time.monotonic())
-            preds = self.bus.get_predictions(qid, n=len(workers), timeout=remaining)
+            t_q = time.monotonic()
+            preds = self.bus.get_predictions(
+                qid, n=len(workers), timeout=remaining,
+                min_n=quorum, grace_s=grace)
+            telemetry.observe("predictor.gather_quorum_s",
+                              time.monotonic() - t_q)
+            for w, _ in preds:
+                replies[w] = replies.get(w, 0) + 1
             if not preds:
                 timeouts += 1
                 out.append({"error": "prediction timeout"})
             else:
+                if len(preds) < len(workers):
+                    hedged += 1
                 out.append(ensemble_predictions([p for _, p in preds]))
-        telemetry.observe("predictor.gather_s", time.monotonic() - t_gather)
+        elapsed = time.monotonic() - t_gather
+        telemetry.observe("predictor.gather_s", elapsed)
         if timeouts:
             telemetry.inc("predictor.query_timeouts", timeouts)
-        return out
+        if hedged:
+            telemetry.inc("predictor.hedged_gathers", hedged)
+        return GatherReport(outputs=out, workers=list(workers),
+                            quorum=quorum, replies=replies,
+                            timeouts=timeouts, hedged=hedged,
+                            elapsed_s=elapsed)
+
+
+def default_quorum(k: int) -> int:
+    """The gateway's default gather quorum: a majority of the fan-out."""
+    return max(1, math.ceil(k / 2))

@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Closed-loop serving load generator for the predict path.
+
+Two modes:
+
+  * ``--url http://host:port`` — drive a LIVE predictor endpoint
+    (``predictor_host`` from the inference-job row) with N closed-loop
+    clients for a fixed request count, measuring end-to-end latency
+    through the serving gateway.
+  * ``--smoke`` (default when no --url) — fully in-process and
+    deterministic: stub-model workers on the in-proc bus behind a real
+    Gateway + PredictorApp WSGI stack, exercised through the werkzeug
+    test client. No sockets, no sleeps beyond the stub service time —
+    the tier-1 wiring in scripts/check_tier1.sh runs this variant.
+
+Output: one JSON object on stdout:
+
+  {"qps": ..., "p50_ms": ..., "p99_ms": ..., "shed_rate": ...,
+   "requests": ..., "ok": ..., "shed": ..., "errors": ...}
+
+Closed-loop means each client fires its next request only after the
+previous one answered (or was shed) — offered load adapts to service
+rate, the standard arrangement for latency benchmarking. Shed (429)
+responses count toward shed_rate, not latency percentiles.
+
+Exit code: 0 on a sane run; 1 when the run itself misbehaved (5xx
+responses, zero completed requests) — that makes the smoke variant a
+CI gate, not just a number printer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def percentile(sorted_xs, p):
+    if not sorted_xs:
+        return None
+    last = len(sorted_xs) - 1
+    return sorted_xs[min(last, int(last * p / 100))]
+
+
+class ClosedLoopClient:
+    """One closed-loop worker: POST, record, repeat."""
+
+    def __init__(self, post, n_requests, payload, record):
+        self._post = post          # (payload) -> (status_code, latency_s)
+        self._n = n_requests
+        self._payload = payload
+        self._record = record
+
+    def run(self):
+        for _ in range(self._n):
+            t0 = time.monotonic()
+            try:
+                status = self._post(self._payload)
+            except Exception:
+                status = -1
+            self._record(status, time.monotonic() - t0)
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.latencies_s = []
+        self.ok = 0
+        self.shed = 0
+        self.errors = 0
+
+    def record(self, status, latency_s):
+        with self._lock:
+            if status == 200:
+                self.ok += 1
+                self.latencies_s.append(latency_s)
+            elif status == 429:
+                self.shed += 1
+            else:
+                self.errors += 1
+
+    def report(self, elapsed_s):
+        with self._lock:
+            xs = sorted(self.latencies_s)
+            total = self.ok + self.shed + self.errors
+            return {
+                "requests": total,
+                "ok": self.ok,
+                "shed": self.shed,
+                "errors": self.errors,
+                "qps": round(total / elapsed_s, 2) if elapsed_s else None,
+                "p50_ms": (None if not xs
+                           else round(percentile(xs, 50) * 1000, 3)),
+                "p99_ms": (None if not xs
+                           else round(percentile(xs, 99) * 1000, 3)),
+                "shed_rate": round(self.shed / total, 4) if total else None,
+            }
+
+
+def run_load(post, n_clients, requests_per_client, payload):
+    recorder = Recorder()
+    clients = [ClosedLoopClient(post, requests_per_client, payload,
+                                recorder.record)
+               for _ in range(n_clients)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    t0 = time.monotonic()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    return recorder.report(time.monotonic() - t0)
+
+
+def run_url_mode(args):
+    import requests
+
+    url = args.url.rstrip("/") + "/predict"
+    session = requests.Session()
+
+    def post(payload):
+        resp = session.post(url, json=payload, timeout=args.deadline_s + 5)
+        return resp.status_code
+
+    payload = {"queries": [[1.0]] * args.queries_per_request,
+               "deadline_s": args.deadline_s}
+    return run_load(post, args.clients, args.requests_per_client, payload)
+
+
+def run_smoke_mode(args):
+    from werkzeug.test import Client
+
+    from rafiki_tpu.bus import InProcBus
+    from rafiki_tpu.gateway import Gateway, GatewayConfig
+    from rafiki_tpu.predictor import Predictor
+    from rafiki_tpu.predictor.app import PredictorApp
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    class StubModel:
+        """Fixed service time, fixed output — no jax, no compile."""
+
+        def predict(self, queries):
+            time.sleep(args.service_ms / 1000.0)
+            return [[0.6, 0.4] for _ in queries]
+
+    bus = InProcBus()
+    stop = threading.Event()
+    threads = []
+    for i in range(args.workers):
+        w = InferenceWorker(bus, "bench", f"bw{i}", StubModel(),
+                            stop_event=stop)
+        th = threading.Thread(target=w.run, daemon=True)
+        threads.append(th)
+        th.start()
+    deadline = time.monotonic() + 10
+    while len(bus.get_workers("bench")) < args.workers:
+        if time.monotonic() > deadline:
+            raise RuntimeError("bench workers never registered")
+        time.sleep(0.005)
+
+    predictor = Predictor(bus, "bench", timeout_s=args.deadline_s)
+    gateway = Gateway(predictor, GatewayConfig(
+        max_inflight=args.max_inflight, max_queue=args.max_queue,
+        hedge_grace_s=0.02))
+    wsgi = Client(PredictorApp(gateway))
+
+    def post(payload):
+        return wsgi.post("/predict", json=payload).status_code
+
+    payload = {"queries": [[1.0]] * args.queries_per_request,
+               "deadline_s": args.deadline_s}
+    try:
+        return run_load(post, args.clients, args.requests_per_client, payload)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=2)
+
+
+def main(argv=None):
+    # Platform pin FIRST: this process may import jax transitively via
+    # the worker/model stack, and the image's sitecustomize would
+    # otherwise hang backend init with the TPU tunnel down.
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", help="live predictor base URL; omit for the "
+                                  "in-process smoke run")
+    ap.add_argument("--smoke", action="store_true",
+                    help="force the in-process deterministic run")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--requests-per-client", type=int, default=25)
+    ap.add_argument("--queries-per-request", type=int, default=4)
+    ap.add_argument("--deadline-s", type=float, default=2.0)
+    ap.add_argument("--workers", type=int, default=2,
+                    help="stub inference workers (smoke mode)")
+    ap.add_argument("--service-ms", type=float, default=1.0,
+                    help="stub model service time (smoke mode)")
+    ap.add_argument("--max-inflight", type=int, default=4)
+    ap.add_argument("--max-queue", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    if args.url and not args.smoke:
+        report = run_url_mode(args)
+        report["mode"] = "url"
+    else:
+        report = run_smoke_mode(args)
+        report["mode"] = "smoke"
+
+    print(json.dumps(report, indent=2))
+
+    if report["errors"] or not report["ok"]:
+        print(f"bench_serving: unhealthy run ({report['errors']} errors, "
+              f"{report['ok']} ok)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,11 @@ cd "$(dirname "$0")/.."
 # tests (docs/static_analysis.md). Cheap (~1s, no jax touch), so it
 # runs before the 870s pytest budget is spent.
 scripts/check_lint.sh > /tmp/_lint.json || { echo "TIER1 LINT FAILED (see /tmp/_lint.json)"; exit 1; }
+# Serving smoke: a deterministic in-process closed-loop run against the
+# gateway + predictor stack (docs/serving.md). Sub-second; fails the
+# gate on any 5xx or zero completed requests.
+env JAX_PLATFORMS=cpu python scripts/bench_serving.py --smoke > /tmp/_bench_serving.json \
+  || { echo "TIER1 SERVING SMOKE FAILED (see /tmp/_bench_serving.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

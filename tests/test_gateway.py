@@ -1,0 +1,381 @@
+"""Serving gateway: admission control, quorum gather, breakers, drain.
+
+The acceptance scenario (ISSUE 3) runs deterministically on the
+in-proc bus: k=3 workers where one is a *fresh-leased corpse* — the
+in-proc stand-in for a SIGKILLed process, registered and heartbeating
+but never serving (the real-SIGKILL variant lives in
+tests/test_serve_elastic.py) — under offered load above the inflight
+budget. The gateway must shed the overflow with 429s, answer every
+admitted request within its deadline via quorum gather, and report
+consistent counts on ``GET /gateway`` and ``/metrics``.
+"""
+
+import threading
+import time
+
+import pytest
+from werkzeug.test import Client
+
+from rafiki_tpu import telemetry
+from rafiki_tpu.bus import InProcBus, make_mp_bus
+from rafiki_tpu.gateway import (
+    AdmissionController, CircuitBreaker, Gateway, GatewayConfig, ShedError)
+from rafiki_tpu.predictor import Predictor
+from rafiki_tpu.predictor.app import PredictorApp
+from rafiki_tpu.worker.inference import InferenceWorker
+
+JOB = "gwjob"
+
+
+class _SlowConst:
+    """Stand-in model: fixed prob vector after a fixed service time."""
+
+    def __init__(self, vec, delay_s=0.0):
+        self.vec = list(vec)
+        self.delay_s = delay_s
+
+    def predict(self, queries):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return [self.vec for _ in queries]
+
+
+class _Serving:
+    """k live in-proc workers plus (optionally) one fresh-leased corpse
+    that never answers — registered, heartbeating, dead to queries."""
+
+    def __init__(self, models, corpse=None, job=JOB):
+        self.bus = InProcBus()
+        self.job = job
+        self.stop = threading.Event()
+        self.threads = []
+        for i, model in enumerate(models):
+            w = InferenceWorker(self.bus, job, f"w{i}", model,
+                                stop_event=self.stop)
+            th = threading.Thread(target=w.run, daemon=True)
+            self.threads.append(th)
+            th.start()
+        deadline = time.monotonic() + 10
+        while len(self.bus.get_workers(job)) < len(models):
+            assert time.monotonic() < deadline, "workers never registered"
+            time.sleep(0.005)
+        self.corpse = corpse
+        if corpse is not None:
+            self.bus.add_worker(job, corpse)
+            th = threading.Thread(target=self._beat_corpse, daemon=True)
+            self.threads.append(th)
+            th.start()
+
+    def _beat_corpse(self):
+        while not self.stop.wait(0.2):
+            self.bus.heartbeat(self.job, self.corpse)
+
+    def close(self):
+        self.stop.set()
+        for th in self.threads:
+            th.join(timeout=2)
+
+
+def _no_errors(preds):
+    return all(not (isinstance(p, dict) and "error" in p) for p in preds)
+
+
+# -- the acceptance scenario -------------------------------------------------
+
+
+def test_gateway_sheds_and_answers_admitted_within_deadline():
+    """k=3 (one fresh-leased corpse), offered load > inflight budget:
+    (a) overflow shed with 429 + Retry-After, (b) every admitted
+    request answered within its deadline with NO timeout errors,
+    (c) /gateway and /metrics agree on admitted/shed/hedged and show
+    the corpse's breaker tripping."""
+    telemetry.reset()
+    serving = _Serving([_SlowConst([0.8, 0.2], 0.05),
+                        _SlowConst([0.6, 0.4], 0.05)], corpse="stuck")
+    try:
+        predictor = Predictor(serving.bus, JOB, timeout_s=5.0)
+        gateway = Gateway(predictor, GatewayConfig(
+            max_inflight=1, max_queue=1, hedge_grace_s=0.05,
+            breaker_failures=3))
+        app = Client(PredictorApp(gateway))
+
+        deadline_s = 4.0
+        offered = 12
+        results = []
+        results_lock = threading.Lock()
+
+        def fire():
+            t0 = time.monotonic()
+            r = app.post("/predict",
+                         json={"queries": [[1.0]], "deadline_s": deadline_s})
+            with results_lock:
+                results.append((r.status_code, time.monotonic() - t0,
+                                r.get_json(), dict(r.headers)))
+
+        threads = [threading.Thread(target=fire) for _ in range(offered)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+
+        codes = sorted(c for c, _, _, _ in results)
+        assert codes.count(429) >= 1, f"nothing shed: {codes}"
+        assert codes.count(200) >= 1, f"nothing admitted: {codes}"
+        assert set(codes) <= {200, 429}, codes
+        for code, dt, body, headers in results:
+            if code == 200:
+                # Admitted ⇒ answered within the deadline via quorum
+                # gather — never a "prediction timeout" masquerading
+                # as an answer, never a blown deadline.
+                assert dt < deadline_s, f"admitted request took {dt:.2f}s"
+                assert _no_errors(body["predictions"]), body
+            else:
+                assert "Retry-After" in headers
+                assert int(headers["Retry-After"]) >= 1
+
+        # Force the corpse's breaker open with a few sequential batches.
+        for _ in range(3):
+            assert app.post("/predict",
+                            json={"queries": [[1.0]]}).status_code == 200
+
+        stats = app.get("/gateway").get_json()
+        snap = app.get("/metrics").get_json()
+        assert stats["admitted"] == snap["counters"]["gateway.admitted"]
+        assert stats["shed_total"] == snap["counters"]["gateway.shed"]
+        assert stats["hedged"] == snap["counters"].get("gateway.hedged", 0)
+        assert stats["timeouts"] == 0
+        assert stats["admitted"] + stats["shed_total"] == offered + 3
+        # While the corpse was still in the fan-out, quorum (2 of 3) +
+        # grace closed those gathers early — hedging happened.
+        assert stats["hedged"] >= 1
+        stuck = stats["breakers"]["stuck"]
+        assert stuck["failures"] >= 3
+        assert stuck["state"] == "open"
+        # /metrics carries the same breaker state via the collector.
+        assert snap["gateway"]["breakers"]["stuck"]["state"] == "open"
+        assert snap["counters"]["gateway.breaker_opened"] >= 1
+    finally:
+        serving.close()
+
+
+# -- admission ---------------------------------------------------------------
+
+
+def test_admission_deadline_shed():
+    ac = AdmissionController(max_inflight=1, max_queue=4)
+    assert ac.admit(time.monotonic() + 1.0) == 0.0
+    with pytest.raises(ShedError) as e:
+        ac.admit(time.monotonic() + 0.05)
+    assert e.value.reason == "deadline"
+    ac.release()
+    assert ac.inflight == 0
+
+
+def test_admission_queue_full_shed():
+    ac = AdmissionController(max_inflight=1, max_queue=0)
+    ac.admit(time.monotonic() + 1.0)
+    with pytest.raises(ShedError) as e:
+        ac.admit(time.monotonic() + 1.0)
+    assert e.value.reason == "queue_full"
+    ac.release()
+
+
+def test_admission_waiter_gets_freed_slot():
+    ac = AdmissionController(max_inflight=1, max_queue=1)
+    ac.admit(time.monotonic() + 5.0)
+    got = []
+
+    def wait_for_slot():
+        got.append(ac.admit(time.monotonic() + 5.0))
+
+    th = threading.Thread(target=wait_for_slot)
+    th.start()
+    time.sleep(0.05)
+    assert not got  # still queued
+    ac.release()
+    th.join(timeout=2)
+    assert len(got) == 1 and got[0] > 0  # waited, then admitted
+    ac.release()
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+def test_breaker_open_half_open_close_transitions():
+    now = [0.0]
+    br = CircuitBreaker(failure_threshold=2, cooldown_s=5.0,
+                        clock=lambda: now[0])
+    assert br.allow() and br.state == "closed"
+    br.record_failure()
+    assert br.state == "closed"  # below threshold
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow()
+    now[0] = 5.1  # cooldown elapsed → half-open, exactly one probe
+    assert br.allow()
+    assert br.state == "half-open"
+    assert not br.allow()  # second probe refused while first is out
+    br.record_failure()  # probe missed → reopen for a full cooldown
+    assert br.state == "open"
+    assert not br.allow()
+    now[0] = 10.3
+    assert br.allow()
+    br.record_success()
+    assert br.state == "closed"
+    assert br.allow()
+    snap = br.snapshot()
+    assert snap["failures"] == 3 and snap["successes"] == 1
+
+
+# -- routing -----------------------------------------------------------------
+
+
+def test_least_loaded_routes_to_emptiest_worker():
+    serving = _Serving([_SlowConst([1.0, 0.0])])  # w0: the live worker
+    try:
+        bus = serving.bus
+        # A second registered worker with a backlog: least-loaded must
+        # route around it (and with quorum 1, its silence is harmless).
+        bus.add_worker(JOB, "busy")
+        bus.add_query("busy", "preload-1", [0.0])
+        bus.add_query("busy", "preload-2", [0.0])
+        predictor = Predictor(bus, JOB, timeout_s=2.0)
+        gateway = Gateway(predictor,
+                          GatewayConfig(policy="least-loaded"))
+        out = gateway.predict([[1.0], [2.0]])
+        assert out == [[1.0, 0.0], [1.0, 0.0]]  # w0's vector, no ensemble
+        assert bus.queue_depth("busy") == 2  # nothing new routed to it
+    finally:
+        serving.close()
+
+
+# -- drain -------------------------------------------------------------------
+
+
+def test_drain_flushes_inflight_and_sheds_new():
+    serving = _Serving([_SlowConst([0.5, 0.5], 0.3)])
+    try:
+        predictor = Predictor(serving.bus, JOB, timeout_s=5.0)
+        gateway = Gateway(predictor, GatewayConfig(max_inflight=2))
+        app = Client(PredictorApp(gateway))
+        inflight_result = []
+
+        def inflight_request():
+            inflight_result.append(
+                app.post("/predict", json={"queries": [[1.0]]}))
+
+        th = threading.Thread(target=inflight_request)
+        th.start()
+        time.sleep(0.1)  # let it get admitted into the slow forward
+        assert gateway.drain(timeout=5.0), "inflight never flushed"
+        th.join(timeout=5)
+        # The admitted request ran to completion through the drain.
+        assert inflight_result[0].status_code == 200
+        # New arrivals shed as draining (503 at the HTTP layer) and
+        # health flips.
+        r = app.post("/predict", json={"queries": [[1.0]]})
+        assert r.status_code == 503
+        assert r.get_json()["reason"] == "draining"
+        h = app.get("/healthz")
+        assert h.status_code == 503
+        assert h.get_json()["status"] == "draining"
+        assert app.get("/gateway").get_json()["draining"] is True
+    finally:
+        serving.close()
+
+
+# -- HTTP request validation -------------------------------------------------
+
+
+def test_predict_request_limits_and_malformed_bodies():
+    serving = _Serving([_SlowConst([0.5, 0.5])])
+    try:
+        predictor = Predictor(serving.bus, JOB, timeout_s=2.0)
+        gateway = Gateway(predictor,
+                          GatewayConfig(max_queries_per_request=4))
+        app = Client(PredictorApp(gateway))
+        assert app.post("/predict",
+                        json={"queries": [[1.0]]}).status_code == 200
+        # Over the per-request cap → 413, never fanned out.
+        assert app.post("/predict",
+                        json={"queries": [[1.0]] * 5}).status_code == 413
+        # Malformed bodies stay 400: non-JSON, non-dict JSON,
+        # missing/non-list queries, junk deadline.
+        assert app.post("/predict", data="{[",
+                        content_type="application/json").status_code == 400
+        assert app.post("/predict", json=[1, 2]).status_code == 400
+        assert app.post("/predict", json={"queries": "x"}).status_code == 400
+        assert app.post("/predict",
+                        json={"queries": [[1.0]],
+                              "deadline_s": "soon"}).status_code == 400
+        assert app.post("/predict",
+                        json={"queries": [[1.0]],
+                              "deadline_s": -1}).status_code == 400
+    finally:
+        serving.close()
+
+
+# -- bus satellites ----------------------------------------------------------
+
+
+def test_inproc_bus_depth_counter_tracks_queue():
+    bus = InProcBus()
+    bus.add_worker("j", "w")
+    for i in range(3):
+        bus.add_query("w", f"q{i}", [float(i)])
+    assert bus.queue_depth("w") == 3
+    assert telemetry.get_gauge("bus.queue_depth") == 3
+    items = bus.pop_queries("w", max_n=64, timeout=0.1)
+    assert len(items) == 3
+    assert bus.queue_depth("w") == 0
+    # Dropping a worker with a backlog must not strand the counter.
+    bus.add_query("w", "q3", [3.0])
+    bus.remove_worker("j", "w")
+    assert bus._depth == 0
+
+
+def test_mp_bus_expired_trim_is_insertion_ordered():
+    """Regression for the coarse `self._expired.clear()`: overflowing
+    the expiry cap must forget only the OLDEST ids — recently expired
+    queries keep rejecting late answers."""
+    bus = make_mp_bus()
+    bus._expired_cap = 8
+    for i in range(9):  # expire 9 ids through a cap of 8
+        bus.get_predictions(f"q{i}", n=1, timeout=0)
+    # Recent ids are still guarded: a late answer is dropped...
+    bus.put_prediction("q8", "w", [1.0])
+    assert bus._preds.get("q8", ()) == ()
+    bus.put_prediction("q1", "w", [1.0])
+    assert bus._preds.get("q1", ()) == ()
+    # ...while only the single oldest id (q0) was trimmed and re-leaks
+    # one slot, the documented cost of the bounded window.
+    bus.put_prediction("q0", "w", [1.0])
+    assert len(bus._preds.get("q0", ())) == 1
+
+
+# -- quorum gather on the in-proc bus ----------------------------------------
+
+
+def test_quorum_gather_returns_before_straggler_deadline():
+    """Wait-for-quorum + hedge grace: with one silent replica, the
+    gather closes in ~grace time, not the full timeout."""
+    serving = _Serving([_SlowConst([0.8, 0.2]), _SlowConst([0.6, 0.4])],
+                       corpse="stuck")
+    try:
+        predictor = Predictor(serving.bus, JOB, timeout_s=5.0)
+        t0 = time.monotonic()
+        report = predictor.predict_detailed(
+            [[1.0]], min_replies=2, hedge_grace_s=0.1)
+        dt = time.monotonic() - t0
+        assert report.ok()
+        assert dt < 2.0, f"quorum gather stalled on the corpse: {dt:.2f}s"
+        assert report.hedged == 1
+        assert report.replies.get("stuck", 0) == 0
+        assert report.quorum == 2
+        # Default (no quorum) still waits for all — here, the timeout.
+        t0 = time.monotonic()
+        full = predictor.predict_detailed([[1.0]], timeout_s=0.5)
+        assert time.monotonic() - t0 >= 0.5
+        assert full.ok()  # partial ensemble of the two live replies
+    finally:
+        serving.close()

@@ -42,7 +42,9 @@ def _ok(out):
 
 @pytest.fixture(scope="module")
 def served(tmp_path_factory):
-    """Two trained trials served by two real worker processes."""
+    """Two trained trials served by THREE real worker processes (the
+    third re-serves trial 0: k=3 gives the quorum test a majority to
+    gather after its straggler is SIGKILLed)."""
     tmp = tmp_path_factory.mktemp("serve")
     store = MetaStore(tmp / "meta.sqlite3")
     params = ParamsStore(tmp / "params")
@@ -58,18 +60,19 @@ def served(tmp_path_factory):
 
     ctx = mp.get_context("spawn")
     bus = make_mp_bus(ctx.Manager())
+    trials = [best[0], best[1], best[0]]
     procs = [
         ctx.Process(
             target=run_inference_worker_process,
             args=(bus, str(tmp / "meta.sqlite3"), str(tmp / "params"),
                   t["id"], JOB, f"iw-{i}"),
             daemon=True)
-        for i, t in enumerate(best)
+        for i, t in enumerate(trials)
     ]
     for p in procs:
         p.start()
     deadline = time.monotonic() + 120
-    while len(bus.get_workers(JOB)) < 2:
+    while len(bus.get_workers(JOB)) < len(procs):
         # Fail FAST on a dead child instead of burning the whole
         # registration deadline: the round-5 regression (spawn target
         # missing honor_env_platform, child hung/died in backend init)
@@ -82,6 +85,54 @@ def served(tmp_path_factory):
     for p in procs:
         if p.is_alive():
             p.kill()
+
+
+def test_quorum_gather_survives_sigkilled_straggler(served):
+    """SIGKILL one of k=3 worker processes mid-load: while its lease is
+    still FRESH (the predictor has no liveness signal yet), a quorum
+    gather through the gateway must keep answering within the deadline
+    — p99 tracks the surviving majority, not the corpse — and the
+    corpse's circuit breaker must start recording misses."""
+    from rafiki_tpu.gateway import Gateway, GatewayConfig
+
+    bus, procs = served
+    pred = Predictor(bus, JOB, timeout_s=TIMEOUT_S, worker_ttl_s=TTL_S)
+    rng = np.random.default_rng(1)
+    queries = list(rng.uniform(0, 1, size=(4, 8, 8, 1)).astype(np.float32))
+
+    # Warm until every worker answers within one deadline (first
+    # forward pays each subprocess's XLA compile): wait-for-all gather
+    # succeeding means all 3 replied in time.
+    deadline = time.monotonic() + 120
+    while True:
+        report = pred.predict_detailed(queries)
+        if _ok(report.outputs) and len(report.replies) == len(procs):
+            break
+        assert time.monotonic() < deadline, "serving never warmed"
+        time.sleep(0.5)
+
+    gateway = Gateway(pred, GatewayConfig(
+        max_inflight=4, min_replies=2, hedge_grace_s=0.2,
+        default_deadline_s=TIMEOUT_S))
+
+    # SIGKILL the straggler-to-be mid-load; its lease stays fresh for
+    # up to TTL_S, during which only the quorum keeps us fast.
+    os.kill(procs[2].pid, signal.SIGKILL)
+    procs[2].join(10)
+    assert not procs[2].is_alive()
+    assert "iw-2" in bus.get_workers(JOB, max_age_s=TTL_S), \
+        "corpse lease expired before the quorum window was exercised"
+
+    for _ in range(3):
+        t0 = time.monotonic()
+        out = gateway.predict(queries)
+        dt = time.monotonic() - t0
+        assert _ok(out), f"quorum batch failed: {out[:2]}"
+        assert dt < TIMEOUT_S, \
+            f"quorum gather waited on the SIGKILLed straggler ({dt:.1f}s)"
+    stats = gateway.stats()
+    assert stats["timeouts"] == 0
+    assert stats["breakers"]["iw-2"]["failures"] >= 1
 
 
 def test_sigkilled_inference_worker_degrades_to_k_minus_1(served):
