@@ -59,6 +59,15 @@ CIFAR-10 ~1.8k img/s => ~28s epoch + eval + AutoML overhead ~30s per
 canonical trial; the reference publishes no numbers). The per-chip
 ratio equals the v5e-8 vs 8xV100 pod ratio. North star: >= 8.
 
+``detail.trial_pack`` reports the packed-vs-serial microbench: k
+same-program trials trained as one vmapped pack vs back-to-back serial
+(docs/trial_packing.md), with the per-trial score parity delta. When
+the TPU tunnel is down past the probe retries, the bench no longer
+exits rc=1 with a zero artifact: it falls back to CPU, runs the
+program-cache + packing microbench only, records ``detail.degraded``
+with ``value``/``vs_baseline`` null, and exits 0 — the perf trajectory
+keeps its honest, reduced data point.
+
 Env knobs: RAFIKI_BENCH_TRIALS (default 30), RAFIKI_BENCH_DEADLINE_S
 (default 1500), RAFIKI_BENCH_PLATFORM=cpu (tiny smoke-scale run for
 tests), RAFIKI_BENCH_SELFTEST_FAIL=1 (forced failure, tests the error
@@ -150,8 +159,12 @@ def _probe_backend_subprocess(timeout_s: float) -> tuple[bool, str]:
     return True, r.stdout.strip()
 
 
-def _init_backend() -> str:
-    """Retry-with-backoff backend init; returns the platform string."""
+def _init_backend() -> "tuple[str, str | None]":
+    """Retry-with-backoff backend init. Returns (platform, degraded):
+    ``degraded`` is None on the requested backend, or the reason string
+    when the TPU probe exhausted its retries and the bench fell back to
+    CPU — the caller then runs the reduced (microbench-only) artifact
+    instead of exiting rc=1 with zero values (BENCH_r01–r05's gap)."""
     if os.environ.get("RAFIKI_BENCH_SELFTEST_FAIL"):
         raise RuntimeError("selftest: forced backend failure")
     from rafiki_tpu.utils.backend import force_cpu_backend, honor_env_platform
@@ -160,11 +173,11 @@ def _init_backend() -> str:
         force_cpu_backend()
         import jax
 
-        return jax.devices()[0].platform
+        return jax.devices()[0].platform, None
     if honor_env_platform():  # JAX_PLATFORMS=cpu: skip the TPU probe
         import jax
 
-        return jax.devices()[0].platform
+        return jax.devices()[0].platform, None
     # ~460s worst-case probe budget: leaves ~1000s of the default
     # 1500s deadline for the measured run if the tunnel recovers late.
     delays = [0, 10, 30, 60]
@@ -177,8 +190,13 @@ def _init_backend() -> str:
         if ok:
             import jax
 
-            return jax.devices()[0].platform
-    raise RuntimeError(f"backend unavailable after {len(delays)} attempts: {last}")
+            return jax.devices()[0].platform, None
+    force_cpu_backend()
+    import jax
+
+    return (jax.devices()[0].platform,
+            f"backend unavailable after {len(delays)} attempts: {last}; "
+            f"CPU fallback — headline unmeasured, microbench only")
 
 
 # -- canonical bench model ---------------------------------------------------
@@ -455,6 +473,88 @@ def _measure_serving(store, params, result, sc: dict, detail: dict) -> None:
         sm.stop_inference_services(inf["id"])
 
 
+# -- trial packing: packed-vs-serial microbench ------------------------------
+
+PACK_MODEL_SRC = b'''
+from rafiki_tpu.model.base import JaxModel
+from rafiki_tpu.model.knobs import FixedKnob, FloatKnob
+from rafiki_tpu.models.ff import _Mlp
+
+
+class PackFF(JaxModel):
+    """Fixed-shape FF for the trial-pack microbench: every lr shares
+    one program key, so k trials always bucket into one pack."""
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "learning_rate": FloatKnob(1e-4, 1e-1, is_exp=True),
+            "batch_size": FixedKnob(64),
+            "epochs": FixedKnob(2),
+            "seed": FixedKnob(0),
+        }
+
+    def build_module(self, num_classes, input_shape):
+        return _Mlp(hidden_layers=2, hidden_units=128, num_classes=num_classes)
+'''
+
+
+def run_trial_pack_micro(sc: dict, detail: dict) -> None:
+    """Packed-vs-serial trial throughput (docs/trial_packing.md): k
+    same-program trials trained once back-to-back serially and once as
+    a single vmapped pack, both WARM (each path's programs compiled by
+    a throwaway round first — this measures the steady state the
+    packing lever targets, not compile amortization, which is the
+    program cache's own detail block). ``max_score_delta`` doubles as
+    a parity check: packed per-trial scores must match serial ones."""
+    from rafiki_tpu.model.base import load_model_class
+
+    cls = load_model_class(PACK_MODEL_SRC, "PackFF")
+    train = (f"synthetic://images?classes=10&n=2048&w=8&h=8&c=3&seed=0"
+             f"&noise={sc['noise']}&flip={sc['flip']}")
+    val = (f"synthetic://images?classes=10&n=512&w=8&h=8&c=3&seed=1"
+           f"&noise={sc['noise']}&flip={sc['flip']}")
+    k, epochs = 4, 2
+    lrs = [3e-3, 1e-2, 3e-2, 1e-3]
+
+    def serial_once() -> list:
+        scores = []
+        for lr in lrs:
+            m = cls(learning_rate=lr)
+            m.train(train)
+            scores.append(float(m.evaluate(val)))
+            m.destroy()
+        return scores
+
+    def packed_once() -> list:
+        models = [cls(learning_rate=lr) for lr in lrs]
+        cls.train_packed(models, train)
+        scores = cls.evaluate_packed(models, val)
+        for m in models:
+            m.destroy()
+        return scores
+
+    serial_once()
+    packed_once()  # both compiled programs now warm
+    t0 = time.monotonic()
+    s_serial = serial_once()
+    serial_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    s_packed = packed_once()
+    packed_s = time.monotonic() - t0
+    detail["trial_pack"] = {
+        "k": k,
+        "epochs": epochs,
+        "serial_s": round(serial_s, 3),
+        "serial_s_per_trial": round(serial_s / k, 3),
+        "packed_s": round(packed_s, 3),
+        "packed_s_per_trial": round(packed_s / k, 3),
+        "speedup_vs_serial": round(serial_s / packed_s, 2),
+        "max_score_delta": round(max(abs(a - b)
+                                     for a, b in zip(s_serial, s_packed)), 4),
+    }
+
+
 # -- advisor lift: GP vs random on tiny real trials --------------------------
 
 LIFT_MODEL_SRC = b'''
@@ -671,7 +771,7 @@ def main() -> None:
     wd = _watchdog(deadline)
     detail = _OUT["detail"]
     try:
-        platform = _init_backend()
+        platform, degraded = _init_backend()
         # Always recorded, even on failure paths below: a green-window
         # artifact with mfu null must say WHICH platform produced it.
         detail["platform"] = platform
@@ -693,6 +793,24 @@ def main() -> None:
         detail["n_trials_requested"] = sc["trials"]
         from rafiki_tpu import telemetry
 
+        if degraded:
+            # TPU tunnel down: the headline is unmeasurable, but a
+            # zero-value rc=1 artifact leaves the perf trajectory empty
+            # (BENCH_r01–r05). Measure what a CPU honestly can — the
+            # program-cache + trial-packing microbench — mark the
+            # artifact degraded, null the baseline ratio, exit green.
+            detail["degraded"] = degraded
+            run_trial_pack_micro(sc, detail)
+            from rafiki_tpu.ops.train import program_cache_stats
+
+            detail["program_cache"] = program_cache_stats()
+            detail["telemetry"] = telemetry.snapshot()
+            _OUT["value"] = None
+            _OUT["vs_baseline"] = None
+            _emit()
+            wd.cancel()
+            return
+
         run_real_loop(sc, detail)  # first: its compiles must be COLD
         # Embed the span/metric snapshot NOW, while it holds exactly the
         # headline job's trials — per-phase spans (advisor-propose /
@@ -702,6 +820,7 @@ def main() -> None:
         # final artifact also covers serving/micro/lift activity.
         detail["telemetry"] = telemetry.snapshot()
         run_micro(sc, detail)
+        run_trial_pack_micro(sc, detail)
         run_advisor_lift(sc, detail)
         detail["telemetry"] = telemetry.snapshot()
         if detail.get("top1_miss"):

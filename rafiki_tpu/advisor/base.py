@@ -125,6 +125,15 @@ class BaseAdvisor:
         with self._lock:
             return self._propose()
 
+    def propose_batch(self, n: int) -> List[Knobs]:
+        """q proposals for one trial pack (worker/train.py
+        PackedTrialRunner). Default: n sequential ``_propose`` calls
+        under one lock hold — the constant-liar pending list already
+        steers each call away from its predecessors. Engines with a
+        cheaper/better q-batch strategy override ``_propose_batch``."""
+        with self._lock:
+            return self._propose_batch(max(1, int(n)))
+
     def feedback(self, score: float, knobs: Knobs) -> None:
         with self._lock:
             self.history.append((dict(knobs), float(score)))
@@ -160,6 +169,9 @@ class BaseAdvisor:
     # engine hooks (called under the lock)
     def _propose(self) -> Knobs:
         raise NotImplementedError
+
+    def _propose_batch(self, n: int) -> List[Knobs]:
+        return [self._propose() for _ in range(n)]
 
     def _feedback(self, score: float, knobs: Knobs) -> None:
         pass

@@ -64,6 +64,33 @@ class GpAdvisor(BaseAdvisor):
         self._pending_add(self.space.encode(knobs))
         return knobs
 
+    def _propose_batch(self, n: int) -> List[Knobs]:
+        """q-batch via the constant-liar(min) strategy: after each pick,
+        pretend it scored the worst value seen and refit, so the EI
+        surface collapses around it and the next pick explores
+        elsewhere — the k knob sets of one trial pack aren't
+        near-duplicates. The lies are transient: popped (and the GP
+        refit on real data) before returning."""
+        if self.space.d == 0 or self._gp is None or len(self._X) < self.n_initial:
+            return super()._propose_batch(n)  # still exploring randomly
+        out: List[Knobs] = []
+        lies = 0
+        lie = min(self._y)
+        try:
+            for _ in range(n):
+                knobs = self._propose()
+                out.append(knobs)
+                self._X.append(self.space.encode(knobs))
+                self._y.append(lie)
+                lies += 1
+                self._fit()
+        finally:
+            if lies:
+                del self._X[-lies:]
+                del self._y[-lies:]
+                self._fit()
+        return out
+
     def _feedback(self, score: float, knobs: Knobs) -> None:
         x = self.space.encode(knobs)
         self._X.append(x)

@@ -42,6 +42,9 @@ class AdvisorService:
     def propose(self, advisor_id: str) -> Knobs:
         return self.get(advisor_id).propose()
 
+    def propose_batch(self, advisor_id: str, n: int) -> list:
+        return self.get(advisor_id).propose_batch(n)
+
     def feedback(self, advisor_id: str, score: float, knobs: Knobs) -> None:
         self.get(advisor_id).feedback(score, knobs)
 

@@ -208,11 +208,14 @@ class JaxModel(BaseModel):
         return (type(self).__module__, type(self).__qualname__,
                 num_classes, tuple(input_shape), baked, custom_opt) + extra
 
-    def _build_loop(self, num_classes: int, input_shape: tuple):
+    def _loop_fns(self, num_classes: int, input_shape: tuple) -> Dict[str, Any]:
+        """Everything TrainLoop/PackedTrainLoop needs, derived once:
+        the module, the pure fn closures, the optimizer, this trial's
+        dynamic-hyper dict, and the program cache key. Shared by the
+        serial path (``_build_loop``) and the packed path
+        (``train_packed``) so the two can never drift apart."""
         import functools
         import inspect
-
-        from rafiki_tpu.ops.train import TrainLoop
 
         module = self.build_module(num_classes, input_shape)
         # Modules whose __call__ accepts ``dropout_rate`` get it as a
@@ -250,12 +253,26 @@ class JaxModel(BaseModel):
         else:
             optimizer = self.make_base_optimizer()
 
-        self._module = module
+        return {
+            "module": module,
+            "init_fn": init_fn,
+            "apply_eval": apply_eval,
+            "loss_fn": loss_fn,
+            "optimizer": optimizer,
+            "hyper": hyper,
+            "program_key": self._program_key(num_classes, input_shape,
+                                             takes_dropout, custom_opt),
+        }
+
+    def _build_loop(self, num_classes: int, input_shape: tuple):
+        from rafiki_tpu.ops.train import TrainLoop
+
+        fns = self._loop_fns(num_classes, input_shape)
+        self._module = fns["module"]
         self._loop = TrainLoop(
-            init_fn, apply_eval, loss_fn, optimizer,
-            mesh=self._mesh, seed=self._seed, hyper=hyper,
-            program_key=self._program_key(num_classes, input_shape,
-                                          takes_dropout, custom_opt))
+            fns["init_fn"], fns["apply_eval"], fns["loss_fn"], fns["optimizer"],
+            mesh=self._mesh, seed=self._seed, hyper=fns["hyper"],
+            program_key=fns["program_key"])
         self._arch = (num_classes, tuple(input_shape))
 
     def _input_dtype(self):
@@ -308,6 +325,121 @@ class JaxModel(BaseModel):
         ds = self._prepared_dataset(dataset_uri)
         self._check_label_space(ds)
         return float(self._loop.evaluate(ds, self.batch_size))
+
+    # -- trial packing (docs/trial_packing.md) -------------------------------
+
+    @classmethod
+    def packable(cls) -> bool:
+        """Whether instances of this template may join a trial pack.
+        A pack shares ONE device-resident dataset upload, so templates
+        with a custom ``preprocess`` (whose output may depend on
+        per-trial knobs) are excluded."""
+        return cls.preprocess is JaxModel.preprocess
+
+    def packing_key(self, ds: Dataset):
+        """Bucket key for the PackedTrialRunner: two models may train
+        in one pack iff their keys are equal — same compiled program
+        (module config + baked knobs), same per-epoch step geometry
+        (batch size, epochs), same dynamic-hyper key set (the hyper
+        dict's keys are part of the traced state structure)."""
+        num_classes, input_shape = self._dataset_arch(ds)
+        self._planned_steps = self.epochs * max(1, ds.size // self.batch_size)
+        fns = self._loop_fns(num_classes, input_shape)
+        return (fns["program_key"], self.batch_size, self.epochs,
+                tuple(sorted(fns["hyper"])))
+
+    @classmethod
+    def train_packed(cls, models: List["JaxModel"], dataset_uri: str,
+                     on_epoch=None) -> List[List[Dict[str, float]]]:
+        """Train k model instances as ONE vmapped program on one device.
+
+        All models must share a packing_key (the caller buckets).
+        Per-trial identity is preserved: model i ends with the params,
+        rng chain and shuffle order a serial ``train()`` with its seed
+        would produce. Returns per-model epoch histories (list of
+        ``{"loss": ..., "acc": ..., "epoch": e}`` dicts) — the caller
+        writes them to each trial's log. ``on_epoch(epoch)`` fires
+        after every packed epoch (worker heartbeats).
+
+        Not supported in a pack (callers enforce; asserted here):
+        meshes (the trial axis IS the parallelism), checkpoint-resume
+        (``_start_epoch > 0``), masked datasets.
+        """
+        from rafiki_tpu.ops.train import PackedTrainLoop
+
+        if not models:
+            return []
+        lead = models[0]
+        keys = {id(m): m.packing_key(lead._prepared_dataset(dataset_uri))
+                for m in models}
+        if len(set(map(repr, keys.values()))) != 1:
+            raise ValueError("train_packed models do not share a packing key; "
+                             "bucket with packing_key() first")
+        for m in models:
+            if m._mesh is not None:
+                raise ValueError("packed trials are single-device; mesh is set")
+            if m._start_epoch > 0:
+                raise ValueError("packed trials cannot resume from checkpoint")
+        ds = lead._prepared_dataset(dataset_uri)
+        if ds.mask is not None:
+            raise ValueError("packed training does not support masked datasets")
+        num_classes, input_shape = lead._dataset_arch(ds)
+        epochs, batch_size = lead.epochs, lead.batch_size
+
+        # One set of traced closures (the lead's — program_key equality
+        # makes them interchangeable), k hyper dicts/seeds.
+        fns = lead._loop_fns(num_classes, input_shape)
+        hypers = []
+        for m in models:
+            m._planned_steps = epochs * max(1, ds.size // batch_size)
+            m._dataset_meta = dict(ds.meta)
+            mf = m._loop_fns(num_classes, input_shape)
+            hypers.append(mf["hyper"])
+        packed = PackedTrainLoop(
+            fns["init_fn"], fns["apply_eval"], fns["loss_fn"], fns["optimizer"],
+            seeds=[m._seed for m in models], hypers=hypers,
+            program_key=fns["program_key"])
+
+        histories: List[List[Dict[str, float]]] = [[] for _ in models]
+        for epoch in range(epochs):
+            # Serial parity: trial i's shuffle seed is seed_i + epoch,
+            # exactly what train() passes to run_epoch.
+            mts = packed.run_epoch(ds, batch_size,
+                                   [m._seed + epoch for m in models])
+            for i, mt in enumerate(mts):
+                histories[i].append(dict(mt, epoch=epoch))
+            if on_epoch is not None:
+                on_epoch(epoch)
+
+        for i, m in enumerate(models):
+            m._module = fns["module"]
+            m._loop = packed.slice(i)
+            m._arch = (num_classes, tuple(input_shape))
+            m._epochs_done = epochs - 1
+        return histories
+
+    @classmethod
+    def evaluate_packed(cls, models: List["JaxModel"], dataset_uri: str) -> List[float]:
+        """Score a just-packed set of models in ONE shared eval pass:
+        the batch stream is gathered once and every trial's params
+        score it inside one vmapped program. Models must all be slices
+        of the same live pack (i.e. straight out of train_packed)."""
+        from rafiki_tpu.ops.train import PackedSliceLoop
+
+        if not models:
+            return []
+        lead = models[0]
+        loops = [m._loop for m in models]
+        if not all(isinstance(lp, PackedSliceLoop) for lp in loops) or \
+                len({id(lp.packed) for lp in loops}) != 1:
+            # Mixed/serial loops (e.g. after load_parameters): fall back
+            # to per-model evaluate — correctness over the shared pass.
+            return [m.evaluate(dataset_uri) for m in models]
+        ds = lead._prepared_dataset(dataset_uri)
+        for m in models:
+            m._check_label_space(ds)
+        scores = loops[0].packed.evaluate(ds, lead.batch_size)
+        return [float(scores[lp.index]) for lp in loops]
 
     def _check_label_space(self, ds: Dataset) -> None:
         """Fail loudly when an eval dataset's LABEL MEANING diverges

@@ -153,6 +153,50 @@ def effective_lr(hyper: Dict[str, jnp.ndarray], step_i) -> jnp.ndarray:
     return hyper["lr"] * frac
 
 
+def _make_step_fns(init_fn, apply_fn, loss_fn: LossFn,
+                   optimizer: optax.GradientTransformation,
+                   dynamic_lr: bool):
+    """The single-trial step closures shared by :class:`Program` and
+    :class:`PackedProgram`: (train_step, eval_step, predict, init_all).
+    Pure per-trial functions — the packed path vmaps them over a
+    leading trial axis instead of re-deriving the math."""
+    loss4 = _as_hyper_loss(loss_fn)
+
+    def train_step(state, batch):
+        params, opt_state, step_i, rng, hyper = state
+        rng, sub = jax.random.split(rng)
+        (loss, metrics), grads = jax.value_and_grad(loss4, has_aux=True)(
+            params, batch, sub, hyper)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        if dynamic_lr:
+            lr = effective_lr(hyper, step_i)
+            updates = jax.tree.map(lambda u: (-lr).astype(u.dtype) * u, updates)
+        params = optax.apply_updates(params, updates)
+        metrics = dict(metrics, loss=loss)
+        return (params, opt_state, step_i + 1, rng, hyper), metrics
+
+    def eval_step(params, batch):
+        logits = apply_fn(params, batch)
+        labels = batch["y"]
+        mask = labels >= 0
+        if "valid" in batch:
+            v = batch["valid"]
+            mask = jnp.logical_and(mask, v.reshape(v.shape + (1,) * (mask.ndim - v.ndim)))
+        labels_safe = jnp.where(mask, labels, 0)
+        correct = (jnp.argmax(logits, axis=-1) == labels_safe) & mask
+        return correct.sum(), mask.sum()
+
+    def predict(params, batch):
+        logits = apply_fn(params, batch)
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    def init_all(rng):
+        params = init_fn(rng)
+        return params, optimizer.init(params)
+
+    return train_step, eval_step, predict, init_all
+
+
 class Program:
     """The compiled, trial-independent half of a training loop.
 
@@ -178,39 +222,8 @@ class Program:
         self.optimizer = optimizer
         self.dynamic_lr = dynamic_lr
         self.apply_fn = apply_fn
-        loss4 = _as_hyper_loss(loss_fn)
-
-        def train_step(state, batch):
-            params, opt_state, step_i, rng, hyper = state
-            rng, sub = jax.random.split(rng)
-            (loss, metrics), grads = jax.value_and_grad(loss4, has_aux=True)(
-                params, batch, sub, hyper)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            if dynamic_lr:
-                lr = effective_lr(hyper, step_i)
-                updates = jax.tree.map(lambda u: (-lr).astype(u.dtype) * u, updates)
-            params = optax.apply_updates(params, updates)
-            metrics = dict(metrics, loss=loss)
-            return (params, opt_state, step_i + 1, rng, hyper), metrics
-
-        def eval_step(params, batch):
-            logits = apply_fn(params, batch)
-            labels = batch["y"]
-            mask = labels >= 0
-            if "valid" in batch:
-                v = batch["valid"]
-                mask = jnp.logical_and(mask, v.reshape(v.shape + (1,) * (mask.ndim - v.ndim)))
-            labels_safe = jnp.where(mask, labels, 0)
-            correct = (jnp.argmax(logits, axis=-1) == labels_safe) & mask
-            return correct.sum(), mask.sum()
-
-        def predict(params, batch):
-            logits = apply_fn(params, batch)
-            return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-
-        def init_all(rng):
-            params = init_fn(rng)
-            return params, optimizer.init(params)
+        train_step, eval_step, predict, init_all = _make_step_fns(
+            init_fn, apply_fn, loss_fn, optimizer, dynamic_lr)
 
         # Whole-epoch programs over a DEVICE-RESIDENT dataset (single-
         # device path): one lax.scan per epoch, per-step batches
@@ -513,16 +526,32 @@ class TrainLoop:
         count = 0
         metrics = None
         feed_s = 0.0
-        for i, batch in enumerate(dataset.batches(batch_size, shuffle=True, seed=epoch_seed,
-                                                  drop_remainder=True)):
+        # One-slot prefetch (double buffering): batch i+1's host→device
+        # put is issued right after step i is DISPATCHED — jit dispatch
+        # is async, so the transfer overlaps the device step instead of
+        # serializing with it (train.host_feed_s stops adding to
+        # train.step_s on datasets that miss the device-resident path).
+        batches = dataset.batches(batch_size, shuffle=True, seed=epoch_seed,
+                                  drop_remainder=True)
+
+        def put_next():
+            nonlocal feed_s
+            batch = next(batches, None)
+            if batch is None:
+                return None
             batch.pop("valid", None)
             t_feed = time.monotonic()
-            dev_batch = self.plan.put_batch(batch)
+            dev = self.plan.put_batch(batch)
             feed_s += time.monotonic() - t_feed
+            return dev
+
+        dev_batch = put_next()
+        while dev_batch is not None:
             self.state, metrics = self._train_step(self.state, dev_batch)
+            dev_batch = put_next()  # overlaps the in-flight step
+            if on_metrics is not None and (count % 50 == 0):
+                on_metrics(count, {k: float(v) for k, v in metrics.items()})
             count += 1
-            if on_metrics is not None and (i % 50 == 0):
-                on_metrics(i, {k: float(v) for k, v in metrics.items()})
         # Final-step metrics are the epoch result (one host sync per epoch).
         out = {k: float(v) for k, v in metrics.items()} if count else {}
         self._record_epoch(t_epoch, feed_s)
@@ -579,6 +608,291 @@ class TrainLoop:
             if extra:
                 batch.update(extra)
             probs = np.asarray(self._predict(self.state[0], self.plan.put_batch(batch)))
+            outs.append(probs[: batch_size - pad] if pad else probs)
+        return np.concatenate(outs) if outs else np.zeros((0,))
+
+
+# ---------------------------------------------------------------------------
+# Trial packing: k same-program trials vectorized into one XLA program
+# ---------------------------------------------------------------------------
+#
+# The program cache makes back-to-back same-shape trials compile-free,
+# but one Rafiki-scale trial stream nowhere near saturates a chip's
+# MXU. PackedProgram vmaps the SAME per-trial step closures over a
+# leading trial axis: k learning rates, warmups, dropouts and rng
+# streams advance in lockstep inside one jit'd (donated) program, and
+# the pack shares one device-resident dataset upload. Per-trial
+# identity is preserved exactly — trial i's params, rng chain and
+# shuffle order match what a serial TrainLoop(seed_i) would produce —
+# so scores are comparable to serial runs within numeric tolerance.
+#
+# Packing composes with the program cache, not with the dp mesh:
+# a packed trial is single-device by construction (the trial axis IS
+# the parallelism), and multihost SPMD groups must keep packing off
+# (docs/trial_packing.md).
+
+
+class PackedProgram:
+    """The compiled half of a k-trial pack: vmapped, jit'd steps.
+
+    Safe to share (via the process-wide program cache) across packs
+    whose traced computation AND pack width k are identical; per-pack
+    state lives in :class:`PackedTrainLoop`.
+    """
+
+    def __init__(self, init_fn, apply_fn, loss_fn: LossFn,
+                 optimizer: optax.GradientTransformation, k: int,
+                 dynamic_lr: bool = True):
+        if k < 1:
+            raise ValueError(f"pack width k={k} must be >= 1")
+        self.k = k
+        self.plan = _ShardingPlan.build(None)  # packing is single-device
+        self.optimizer = optimizer
+        self.dynamic_lr = dynamic_lr
+        self.apply_fn = apply_fn
+        train_step, eval_step, predict, init_all = _make_step_fns(
+            init_fn, apply_fn, loss_fn, optimizer, dynamic_lr)
+
+        # Trial axis 0 everywhere in the carried state; eval/predict
+        # share one batch across trials (in_axes=(0, None)) while the
+        # train step feeds each trial ITS OWN batch so per-trial
+        # shuffle order matches a serial run.
+        v_train = jax.vmap(train_step)
+        v_eval = jax.vmap(eval_step, in_axes=(0, None))
+        v_predict = jax.vmap(predict, in_axes=(0, None))
+        v_init = jax.vmap(init_all)
+
+        def packed_train_epoch(state, X, Y, idx):
+            # idx: (n_steps, k, batch) int32 — per-trial permutations.
+            def body(st, ib):
+                batch = {"x": jnp.take(X, ib, axis=0),
+                         "y": jnp.take(Y, ib, axis=0)}
+                return v_train(st, batch)
+
+            state, ms = jax.lax.scan(body, state, idx)
+            # Final-step metrics per trial: each value is (k,).
+            return state, {key: v[-1] for key, v in ms.items()}
+
+        def packed_eval_epoch(params, X, Y, idx):
+            # idx: (n_steps, batch) — eval order is shared (no shuffle).
+            def body(carry, ib):
+                batch = {"x": jnp.take(X, ib, axis=0),
+                         "y": jnp.take(Y, ib, axis=0)}
+                c, n = v_eval(params, batch)
+                return (carry[0] + c, carry[1] + n), None
+
+            zero = jnp.zeros((k,), jnp.int32)
+            (c, n), _ = jax.lax.scan(body, (zero, zero), idx)
+            return c, n
+
+        self.train_step = jax.jit(v_train, donate_argnums=(0,))
+        self.eval_step = jax.jit(v_eval)
+        self.predict = jax.jit(v_predict)
+        self.init = jax.jit(v_init)
+        self.train_epoch = jax.jit(packed_train_epoch, donate_argnums=(0,))
+        self.eval_epoch = jax.jit(packed_eval_epoch)
+
+
+def packed_program_key(program_key: Hashable, k: int, dynamic_lr: bool) -> Hashable:
+    """Cache key for a PackedProgram. Structurally distinct from the
+    unpacked key form ``(program_key, mesh_key, dynamic_lr)`` — the
+    leading tag guarantees packed and unpacked programs never collide
+    in the process-wide cache even for identical base keys."""
+    return ("packed", int(k), program_key, bool(dynamic_lr))
+
+
+class PackedTrainLoop:
+    """Per-pack state driving a (possibly cached) PackedProgram.
+
+    Parameters mirror :class:`TrainLoop`, pluralized: ``seeds`` is the
+    k per-trial init seeds; ``hypers`` the k per-trial dynamic-scalar
+    dicts (identical key sets — a structural requirement, since the
+    hyper dict's keys are part of the trace). Trial i of the pack is
+    bit-for-bit the same *computation* as ``TrainLoop(seed=seeds[i],
+    hyper=hypers[i])`` — only batched.
+    """
+
+    def __init__(self, init_fn, apply_fn, loss_fn, optimizer=None,
+                 seeds: Optional[list] = None,
+                 hypers: Optional[list] = None,
+                 program_key: Optional[Hashable] = None):
+        if not seeds:
+            raise ValueError("PackedTrainLoop needs at least one seed")
+        self.k = len(seeds)
+        hypers = hypers if hypers is not None else [{} for _ in seeds]
+        if len(hypers) != self.k:
+            raise ValueError(f"{len(hypers)} hyper dicts for {self.k} seeds")
+        keysets = {tuple(sorted(h)) for h in hypers}
+        if len(keysets) != 1:
+            raise ValueError(
+                f"pack members carry different hyper keys {sorted(keysets)}; "
+                f"the hyper dict's key set is part of the traced program")
+        dynamic_lr = "lr" in hypers[0]
+        if optimizer is None:
+            optimizer = optax.scale_by_adam() if dynamic_lr else optax.adam(1e-3)
+        k = self.k
+
+        def build() -> PackedProgram:
+            return PackedProgram(init_fn, apply_fn, loss_fn, optimizer, k,
+                                 dynamic_lr=dynamic_lr)
+
+        if program_key is not None:
+            self.program = get_program(
+                packed_program_key(program_key, k, dynamic_lr), build)
+        else:
+            self.program = build()
+        self.plan = self.program.plan
+        self.optimizer = self.program.optimizer
+
+        # Per-trial rng derivation matches TrainLoop exactly: key(seed)
+        # split once; row 0 carries on as the step rng, row 1 seeds init.
+        keys = jnp.stack([jax.random.PRNGKey(int(s)) for s in seeds])
+        split = jax.vmap(jax.random.split)(keys)  # (k, 2, key)
+        rngs, init_rngs = split[:, 0], split[:, 1]
+        params, opt_state = self.program.init(init_rngs)
+        hyper_dev = {name: jnp.asarray([float(h[name]) for h in hypers],
+                                       jnp.float32)
+                     for name in hypers[0]}
+        self.state = (params, opt_state, jnp.zeros((k,), jnp.int32),
+                      rngs, hyper_dev)
+
+    # -- per-trial views -----------------------------------------------------
+
+    def trial_params(self, i: int):
+        """Trial i's parameter pytree (device slices of the stacked leaves)."""
+        return jax.tree.map(lambda a: a[i], self.state[0])
+
+    def trial_state(self, i: int):
+        """Trial i's full (params, opt_state, step, rng, hyper) state,
+        shaped exactly like a serial TrainLoop's."""
+        return jax.tree.map(lambda a: a[i], self.state)
+
+    def slice(self, i: int) -> "PackedSliceLoop":
+        return PackedSliceLoop(self, i)
+
+    # -- epochs --------------------------------------------------------------
+
+    def _fits_device_fast_path(self, dataset) -> bool:
+        return (getattr(dataset, "mask", None) is None
+                and dataset.x.nbytes + dataset.y.nbytes <= device_dataset_cap_bytes())
+
+    def run_epoch(self, dataset, batch_size: int, epoch_seeds) -> list:
+        """One epoch for every trial in the pack; ``epoch_seeds`` is the
+        k per-trial shuffle seeds (serial parity: ``seed_i + epoch``).
+        Returns a list of k per-trial final-step metric dicts."""
+        if len(epoch_seeds) != self.k:
+            raise ValueError(f"{len(epoch_seeds)} epoch seeds for pack of {self.k}")
+        if dataset.size < batch_size:
+            raise ValueError(
+                f"Dataset has {dataset.size} examples < batch_size={batch_size}; "
+                f"the epoch would run zero steps")
+        t_epoch = time.monotonic()
+        n_steps = dataset.size // batch_size
+        # (n_steps, k, batch): step-major so lax.scan walks steps while
+        # each trial keeps its own serial-identical permutation.
+        idx = np.stack([
+            np.random.default_rng(int(s)).permutation(dataset.size)
+            [: n_steps * batch_size].reshape(n_steps, batch_size)
+            for s in epoch_seeds], axis=1).astype(np.int32)
+        if self._fits_device_fast_path(dataset):
+            X, Y = get_device_dataset(dataset)
+            self.state, metrics = self.program.train_epoch(self.state, X, Y, idx)
+            self._record_epoch(t_epoch)
+            host = {key: np.asarray(jax.device_get(v)) for key, v in metrics.items()}
+            return [{key: float(v[i]) for key, v in host.items()}
+                    for i in range(self.k)]
+        metrics = None
+        for t in range(n_steps):
+            ib = idx[t]  # (k, batch)
+            batch = {"x": jnp.asarray(dataset.x[ib]),
+                     "y": jnp.asarray(dataset.y[ib])}
+            self.state, metrics = self.program.train_step(self.state, batch)
+        self._record_epoch(t_epoch)
+        host = {key: np.asarray(jax.device_get(v)) for key, v in metrics.items()}
+        return [{key: float(v[i]) for key, v in host.items()}
+                for i in range(self.k)]
+
+    def _record_epoch(self, t0: float) -> None:
+        dt = time.monotonic() - t0
+        cold = not getattr(self, "_warm", False)
+        self._warm = True
+        telemetry.observe("train.packed_cold_epoch_s" if cold
+                          else "train.packed_epoch_s", dt)
+
+    def evaluate(self, dataset, batch_size: int) -> np.ndarray:
+        """(k,) per-trial accuracies over one shared eval pass: the
+        batch stream is uploaded/gathered ONCE and every trial's params
+        score it inside one vmapped program."""
+        total_correct = jnp.zeros((self.k,), jnp.int32)
+        total = jnp.zeros((self.k,), jnp.int32)
+        start = 0
+        if self._fits_device_fast_path(dataset) and dataset.size >= batch_size:
+            X, Y = get_device_dataset(dataset)
+            n_steps = dataset.size // batch_size
+            idx = np.arange(n_steps * batch_size, dtype=np.int32).reshape(
+                n_steps, batch_size)
+            c, n = self.program.eval_epoch(self.state[0], X, Y, idx)
+            total_correct, total = total_correct + c, total + n
+            start = n_steps * batch_size
+        for batch in dataset.batches(batch_size, shuffle=False,
+                                     drop_remainder=False, start=start):
+            dev_batch = self.plan.put_batch(batch)
+            c, n = self.program.eval_step(self.state[0], dev_batch)
+            total_correct = total_correct + c
+            total = total + n
+        c = np.asarray(jax.device_get(total_correct), dtype=np.float64)
+        n = np.asarray(jax.device_get(total), dtype=np.float64)
+        return c / np.maximum(n, 1.0)
+
+
+class PackedSliceLoop:
+    """A per-trial, TrainLoop-shaped view over a PackedTrainLoop.
+
+    Exposes exactly the surface JaxModel touches after training
+    (``params``/``state``/``evaluate``/``predict_proba``), so a model
+    trained inside a pack dumps, scores and serves through the same
+    code paths as a serially-trained one. Mutating entry points
+    (run_epoch) are deliberately absent: per-trial training continues
+    only through the pack.
+    """
+
+    def __init__(self, packed: PackedTrainLoop, index: int):
+        if not (0 <= index < packed.k):
+            raise IndexError(f"slice {index} out of pack of {packed.k}")
+        self.packed = packed
+        self.index = index
+        self.plan = packed.plan
+
+    @property
+    def params(self):
+        return self.packed.trial_params(self.index)
+
+    @property
+    def state(self):
+        return self.packed.trial_state(self.index)
+
+    def evaluate(self, dataset, batch_size: int) -> float:
+        # The packed evaluator scores all k trials in one pass; callers
+        # wanting every score should use PackedTrainLoop.evaluate once
+        # instead of k slice evaluates (the jit cache makes the repeat
+        # calls cheap, not free).
+        return float(self.packed.evaluate(dataset, batch_size)[self.index])
+
+    def predict_proba(self, x: np.ndarray, batch_size: int,
+                      extra: Optional[Batch] = None) -> np.ndarray:
+        n = x.shape[0]
+        outs = []
+        for start in range(0, n, batch_size):
+            chunk = x[start : start + batch_size]
+            pad = batch_size - chunk.shape[0]
+            if pad:
+                chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, axis=0)])
+            batch = {"x": chunk}
+            if extra:
+                batch.update(extra)
+            probs = np.asarray(
+                self.packed.program.predict(self.packed.state[0],
+                                            self.plan.put_batch(batch))[self.index])
             outs.append(probs[: batch_size - pad] if pad else probs)
         return np.concatenate(outs) if outs else np.zeros((0,))
 

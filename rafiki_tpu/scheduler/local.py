@@ -50,6 +50,7 @@ class LocalScheduler:
         devices_per_trial: int = 1,
         advisor_kind: str = "gp",
         stop_event: Optional[threading.Event] = None,
+        trial_pack: Optional[int] = None,
     ) -> TrainJobResult:
         """Run a train job to budget exhaustion. Blocking; thread-safe.
 
@@ -59,6 +60,12 @@ class LocalScheduler:
         worker pool sequentially (models are trained one after another,
         each with full parallelism — simplest fair split; the budget is
         per sub-job, as in the reference).
+
+        ``trial_pack``: vmap up to k same-program trials into one XLA
+        program per single-device worker (None → RAFIKI_TRIAL_PACK env,
+        default 1 = off; see docs/trial_packing.md). Ignored by workers
+        that fail the packing eligibility checks (mesh, multihost,
+        custom preprocess, masked dataset).
         """
         t0 = time.time()
         job = self.store.get_train_job(job_id)
@@ -113,7 +120,7 @@ class LocalScheduler:
                     job["train_dataset_uri"], job["val_dataset_uri"], budget,
                     worker_id=f"{job_id[:8]}-w{i}", devices=dev_set,
                     job_created_at=job["created_at"], service_id=service["id"],
-                    stop_event=stop_event,
+                    stop_event=stop_event, trial_pack=trial_pack,
                 )
                 th = threading.Thread(target=self._run_worker, args=(worker, errors),
                                       name=f"train-worker-{i}", daemon=True)

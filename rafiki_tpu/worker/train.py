@@ -35,7 +35,10 @@ from rafiki_tpu.utils.events import events
 
 
 class AdvisorHandle(Protocol):
-    """What the worker needs from an advisor, local or remote."""
+    """What the worker needs from an advisor, local or remote.
+
+    ``propose_batch`` is optional on third-party handles — the packed
+    runner probes with getattr and falls back to n× ``propose``."""
 
     def propose(self) -> Knobs: ...
 
@@ -49,6 +52,9 @@ class InProcAdvisorHandle:
 
     def propose(self) -> Knobs:
         return self._svc.propose(self._id)
+
+    def propose_batch(self, n: int) -> List[Knobs]:
+        return self._svc.propose_batch(self._id, n)
 
     def feedback(self, score: float, knobs: Knobs) -> None:
         self._svc.feedback(self._id, score, knobs)
@@ -72,6 +78,7 @@ class TrainWorker:
         stop_event=None,
         async_persist: bool = True,
         checkpoint_every: Optional[int] = None,
+        trial_pack: Optional[int] = None,
     ):
         if not (isinstance(model_class, type) and issubclass(model_class, BaseModel)):
             raise TypeError("model_class must subclass BaseModel")
@@ -97,6 +104,12 @@ class TrainWorker:
         if checkpoint_every is None:
             checkpoint_every = int(os.environ.get("RAFIKI_CHECKPOINT_EVERY", "0"))
         self.checkpoint_every = int(checkpoint_every)
+        # Trial packing width: k same-program trials vmapped into one
+        # XLA program (docs/trial_packing.md). 1 = off (the default,
+        # behavior-identical to the serial loop).
+        if trial_pack is None:
+            trial_pack = int(os.environ.get("RAFIKI_TRIAL_PACK", "1"))
+        self.trial_pack = max(1, int(trial_pack))
         from rafiki_tpu.config import get_config
 
         self.heartbeat_min_interval_s = get_config().trial_heartbeat_s
@@ -333,8 +346,21 @@ class TrainWorker:
         """Pull trials until the budget is exhausted. Returns #trials run."""
         max_trials = self.budget.get(BudgetType.MODEL_TRIAL_COUNT.value)
         budget_max = int(max_trials) if max_trials is not None else None
+        packer = None
+        if self.trial_pack > 1:
+            packer = PackedTrialRunner(self, self.trial_pack)
+            if not packer.eligible():
+                packer = None  # serial loop below — packing silently off
         try:
             while not self.budget_exhausted():
+                if packer is not None:
+                    ran, drained = packer.run_round(budget_max)
+                    self.trials_run += ran
+                    if ran and self.service_id is not None:
+                        self.store.update_service(self.service_id, heartbeat=True)
+                    if drained:
+                        break
+                    continue
                 with telemetry.span("trial.advisor_propose",
                                     worker_id=self.worker_id):
                     knobs = self.advisor.propose()
@@ -354,6 +380,183 @@ class TrainWorker:
                 # flush would leak one live thread per worker).
                 self._saver.close()
         return self.trials_run
+
+
+class PackedTrialRunner:
+    """Drafts up to ``pack`` proposals per round, buckets them by
+    packing key, and trains each multi-trial bucket as ONE vmapped XLA
+    program (``JaxModel.train_packed``) on this worker's device.
+
+    Every PER-TRIAL contract is preserved: store rows (one per trial,
+    budget-claimed atomically at creation), scores, advisor feedback,
+    TrialLog entries, params persistence and lifecycle events are
+    exactly those of k serial trials — only the wall-clock is shared.
+    Recovery, the predictor's top-k and the gateway therefore see no
+    difference (docs/trial_packing.md).
+    """
+
+    def __init__(self, worker: "TrainWorker", pack: int):
+        self.w = worker
+        self.pack = max(1, int(pack))
+
+    def eligible(self) -> bool:
+        """Packing preconditions, checked once per run(): a packable
+        JaxModel template, a single-device worker (the trial axis IS
+        the parallelism — meshes and multihost SPMD groups must stay
+        serial), and an unmasked train dataset."""
+        import os
+
+        from rafiki_tpu.model.base import JaxModel
+
+        w = self.w
+        if self.pack < 2:
+            return False
+        if not (isinstance(w.model_class, type)
+                and issubclass(w.model_class, JaxModel)):
+            return False
+        if not w.model_class.packable():
+            return False
+        if w.devices is not None and len(w.devices) > 1:
+            return False
+        if int(os.environ.get("RAFIKI_NUM_PROCESSES", "1")) > 1:
+            return False
+        try:
+            from rafiki_tpu.model.dataset import dataset_utils
+
+            if dataset_utils.load(w.train_uri).mask is not None:
+                return False
+        except Exception:
+            return False
+        return True
+
+    def run_round(self, budget_max: Optional[int]) -> "tuple[int, bool]":
+        """One draft-bucket-train round. Returns (trials run, budget
+        drained). Proposals whose packing key matches no other run
+        serially; same-key groups run packed."""
+        w = self.w
+        with telemetry.span("trial.advisor_propose", worker_id=w.worker_id):
+            batch = getattr(w.advisor, "propose_batch", None)
+            proposals = (batch(self.pack) if batch is not None
+                         else [w.advisor.propose() for _ in range(self.pack)])
+        buckets: Dict[Any, List[Knobs]] = {}
+        order: List[Any] = []
+        for kn in proposals:
+            try:
+                m = w.model_class(**kn)
+                key = repr(m.packing_key(m._prepared_dataset(w.train_uri)))
+            except Exception:
+                key = ("unpackable", id(kn))  # unique → runs serially
+            if key not in buckets:
+                order.append(key)
+                buckets[key] = []
+            buckets[key].append(kn)
+        ran = 0
+        for key in order:
+            knobs_list = buckets[key]
+            if len(knobs_list) == 1:
+                if w.run_trial(knobs_list[0], budget_max=budget_max) is None:
+                    return ran, True
+                ran += 1
+            else:
+                n, drained = self._run_packed(knobs_list, budget_max)
+                ran += n
+                if drained:
+                    return ran, True
+        return ran, False
+
+    def _run_packed(self, knobs_list: List[Knobs],
+                    budget_max: Optional[int]) -> "tuple[int, bool]":
+        w = self.w
+        knob_config = w.model_class.get_knob_config()
+        # Claim all rows up front (each claim is an atomic budget slot,
+        # same transaction as the serial path); the pack shrinks to
+        # whatever the budget still allows.
+        rows: List["tuple[str, Knobs]"] = []
+        drained = False
+        for kn in knobs_list:
+            trial = w.store.create_trial(
+                w.sub_id, w.model_class.__name__, kn,
+                worker_id=w.worker_id,
+                shape_sig=knob_config_signature(knob_config, kn),
+                service_id=w.service_id, budget_max=budget_max)
+            if trial is None:
+                drained = True
+                break
+            rows.append((trial["id"], kn))
+        if not rows:
+            return 0, True
+        if len(rows) == 1:
+            # Budget pressure shrank the pack to one: run it serially,
+            # reusing the already-claimed row via the resume path.
+            out = w.run_trial(rows[0][1], resume_trial_id=rows[0][0])
+            return (1 if out is not None else 0), drained
+
+        k = len(rows)
+        telemetry.observe("trial_pack.size", float(k))
+        telemetry.observe("trial_pack.fill_ratio", k / float(self.pack))
+        for tid, kn in rows:
+            events.emit("trial_started", trial_id=tid, sub_job_id=w.sub_id,
+                        model=w.model_class.__name__, worker_id=w.worker_id,
+                        knobs=kn)
+        models: List[BaseModel] = []
+        try:
+            with telemetry.span("trial_pack.total", worker_id=w.worker_id,
+                                k=k), w._device_scope():
+                with telemetry.span("trial_pack.build"):
+                    models = [w.model_class(**kn) for _, kn in rows]
+
+                def heartbeat(_epoch: int) -> None:
+                    if w.service_id is not None:
+                        now = time.monotonic()
+                        if now - w._last_heartbeat >= w.heartbeat_min_interval_s:
+                            w._last_heartbeat = now
+                            w.store.update_service(w.service_id, heartbeat=True)
+
+                with telemetry.span("trial_pack.train"):
+                    histories = w.model_class.train_packed(
+                        models, w.train_uri, on_epoch=heartbeat)
+                with telemetry.span("trial_pack.evaluate"):
+                    scores = w.model_class.evaluate_packed(models, w.val_uri)
+        except Exception:
+            err = traceback.format_exc()
+            for tid, kn in rows:
+                telemetry.inc("worker.trials_errored")
+                w.store.mark_trial_as_errored(tid, err)
+                events.emit("trial_errored", trial_id=tid, worker_id=w.worker_id,
+                            error=err.splitlines()[-1] if err else "")
+                # Same floor-score contract as the serial path: the
+                # advisor learns to avoid the region.
+                try:
+                    w.advisor.feedback(0.0, kn)
+                except Exception:
+                    pass
+            for m in models:
+                try:
+                    m.destroy()
+                except Exception:
+                    pass
+            return k, drained
+
+        # Per-trial bookkeeping in creation order — logs, feedback,
+        # persistence — indistinguishable from k serial trials.
+        for i, (tid, kn) in enumerate(rows):
+            def sink(entry, _tid=tid):
+                w.store.add_trial_log(_tid, entry)
+
+            with logger.capture(sink):
+                logger.define_plot("Training", ["loss", "acc"], x_axis="epoch")
+                for h in histories[i]:
+                    logger.log(**h)
+            score = float(scores[i])
+            w.advisor.feedback(score, kn)
+            telemetry.inc("worker.trials_succeeded")
+            telemetry.inc("worker.packed_trials")
+            if w._saver is not None:
+                w._saver.submit(tid, models[i], score, None)
+            else:
+                w._persist(tid, models[i], score)
+        telemetry.inc("worker.packed_rounds")
+        return k, drained
 
 
 class _AsyncSaver:

@@ -17,6 +17,11 @@ scripts/check_lint.sh > /tmp/_lint.json || { echo "TIER1 LINT FAILED (see /tmp/_
 # gate on any 5xx or zero completed requests.
 env JAX_PLATFORMS=cpu python scripts/bench_serving.py --smoke > /tmp/_bench_serving.json \
   || { echo "TIER1 SERVING SMOKE FAILED (see /tmp/_bench_serving.json)"; exit 1; }
+# Trial-packing smoke: one RAFIKI_TRIAL_PACK=4 worker round over the
+# fixed-shape FF template (docs/trial_packing.md) — asserts per-trial
+# store rows, logs, feedback and the trial_pack.* telemetry. ~3s.
+env JAX_PLATFORMS=cpu RAFIKI_TRIAL_PACK=4 python scripts/smoke_trial_pack.py > /tmp/_smoke_trial_pack.json \
+  || { echo "TIER1 TRIAL PACK SMOKE FAILED (see /tmp/_smoke_trial_pack.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Trial-packing CI smoke: one packed worker round, end to end.
+
+Runs a TrainWorker with ``RAFIKI_TRIAL_PACK`` (default 4) over a
+fixed-shape FF template on synthetic data and asserts the PER-TRIAL
+contract the packed path must preserve (docs/trial_packing.md): one
+COMPLETED store row per trial with a score and persisted params, one
+TrialLog stream per trial, advisor feedback per trial, and the
+``trial_pack.*`` / ``worker.packed_*`` telemetry.
+
+Output: one JSON object on stdout, e.g.
+
+  {"trials": 4, "pack": 4, "packed_rounds": 1.0, "packed_trials": 4.0,
+   "scores": [...], "wall_s": ...}
+
+Exit code: 0 when every assertion holds; 1 otherwise — this is a CI
+gate (scripts/check_tier1.sh), not just a number printer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODEL_SRC = b"""
+from rafiki_tpu.model.base import JaxModel
+from rafiki_tpu.model.knobs import FixedKnob, FloatKnob
+from rafiki_tpu.models.ff import _Mlp
+
+class PackFF(JaxModel):
+    @staticmethod
+    def get_knob_config():
+        return {
+            "learning_rate": FloatKnob(1e-4, 1e-1, is_exp=True),
+            "batch_size": FixedKnob(64),
+            "epochs": FixedKnob(2),
+            "seed": FixedKnob(0),
+        }
+
+    def build_module(self, num_classes, input_shape):
+        return _Mlp(hidden_layers=1, hidden_units=64, num_classes=num_classes)
+"""
+
+TRAIN = "synthetic://images?classes=4&n=512&w=8&h=8&c=1&seed=0"
+VAL = "synthetic://images?classes=4&n=128&w=8&h=8&c=1&seed=1"
+
+
+def main() -> int:
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()
+
+    from rafiki_tpu import telemetry
+    from rafiki_tpu.advisor import AdvisorService
+    from rafiki_tpu.model.base import load_model_class
+    from rafiki_tpu.store import MetaStore, ParamsStore
+    from rafiki_tpu.worker.train import InProcAdvisorHandle, TrainWorker
+
+    pack = max(2, int(os.environ.get("RAFIKI_TRIAL_PACK", "4")))
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="rafiki-packsmoke-") as tmp:
+        store = MetaStore(os.path.join(tmp, "meta.sqlite3"))
+        params = ParamsStore(os.path.join(tmp, "params"))
+        cls = load_model_class(MODEL_SRC, "PackFF")
+        model = store.create_model("packff", "IMAGE_CLASSIFICATION", None,
+                                   MODEL_SRC, "PackFF")
+        job = store.create_train_job("packsmoke", "IMAGE_CLASSIFICATION", None,
+                                     TRAIN, VAL, {"MODEL_TRIAL_COUNT": pack})
+        sub = store.create_sub_train_job(job["id"], model["id"])
+        advisors = AdvisorService()
+        aid = advisors.create_advisor(cls.get_knob_config(), kind="random")
+        worker = TrainWorker(store, params, sub["id"], cls,
+                             InProcAdvisorHandle(advisors, aid),
+                             TRAIN, VAL, {"MODEL_TRIAL_COUNT": pack},
+                             async_persist=False, trial_pack=pack)
+        n = worker.run()
+
+        trials = store.get_trials_of_sub_train_job(sub["id"])
+        snap = telemetry.snapshot()
+        counters = snap["counters"]
+        problems = []
+        if n != pack:
+            problems.append(f"ran {n} trials, expected {pack}")
+        if len(trials) != pack:
+            problems.append(f"{len(trials)} store rows, expected {pack}")
+        for t in trials:
+            if t["status"] != "COMPLETED":
+                problems.append(f"trial {t['id']}: status {t['status']}")
+            if t["score"] is None or not t["params_id"]:
+                problems.append(f"trial {t['id']}: missing score/params")
+            elif not (0.0 <= float(t["score"]) <= 1.0):
+                problems.append(f"trial {t['id']}: score {t['score']} out of range")
+            logs = store.get_trial_logs(t["id"])
+            if sum(e.get("type") == "values" for e in logs) < 1:
+                problems.append(f"trial {t['id']}: no TrialLog values entries")
+        if counters.get("worker.packed_rounds", 0.0) < 1.0:
+            problems.append("worker.packed_rounds counter never incremented "
+                            "(the packed path did not run)")
+        if counters.get("worker.packed_trials", 0.0) < pack:
+            problems.append("worker.packed_trials below pack size")
+        if "trial_pack.size" not in snap["histograms"]:
+            problems.append("trial_pack.size histogram missing")
+
+        out = {
+            "trials": len(trials),
+            "pack": pack,
+            "packed_rounds": counters.get("worker.packed_rounds", 0.0),
+            "packed_trials": counters.get("worker.packed_trials", 0.0),
+            "scores": [round(float(t["score"]), 4) for t in trials
+                       if t["score"] is not None],
+            "wall_s": round(time.monotonic() - t0, 3),
+        }
+        if problems:
+            out["problems"] = problems
+        print(json.dumps(out))
+        return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
