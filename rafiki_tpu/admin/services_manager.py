@@ -96,6 +96,12 @@ class ServicesManager:
             except BaseException as e:  # surfaced via wait_train_job
                 handle.error = e
                 self.store.update_train_job_status(job_id, TrainJobStatus.ERRORED.value)
+                if not isinstance(e, Exception):
+                    # Interrupts (SystemExit, KeyboardInterrupt) must
+                    # keep propagating after being recorded: absorbing
+                    # them here would leave the process undrainable
+                    # (RF006).
+                    raise
 
         thread = threading.Thread(target=run, name=f"train-job-{job_id[:8]}", daemon=True)
         handle = _TrainJobHandle(thread, stop_event)

@@ -1,0 +1,142 @@
+"""RF006 swallowed-interrupt.
+
+Chaos-plane finding (PR 5): recovery depends on signals ACTUALLY
+propagating. A supervise/worker loop that wraps its body in a broad
+``except`` and keeps looping eats ``KeyboardInterrupt``/``SystemExit``
+(both ``BaseException``) — the process becomes unkillable short of
+SIGKILL, drains never finish, and a simulated preemption's SIGTERM
+grace expires into a hard kill. The injected faults that exposed this
+class: ``scheduler.preempt:term`` against a worker whose loop caught
+``BaseException``.
+
+Two tiers:
+
+* **error** — any handler whose clause catches ``BaseException``
+  (bare ``except:``, ``except BaseException``, or a tuple naming
+  ``BaseException``/``KeyboardInterrupt``/``SystemExit``) and whose
+  body neither re-raises nor exits (``return``/``break``/
+  ``sys.exit``/``os._exit``). Catching the interrupt hierarchy is
+  only ever legitimate as catch-log-REraise.
+* **warning** — an ``except Exception`` handler whose body is nothing
+  but ``pass``/``continue``, directly inside a ``while`` loop of a
+  long-running-loop function (``run``/``serve``/``supervise``/
+  ``recover*``/``watch*``/``main``/``*_loop``/``*_beat``): silent
+  swallow-and-keep-looping hides every failure the loop will ever
+  have, including the chaos plane's injected ones. Log, count, or
+  justify with an inline suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional
+
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+from rafiki_tpu.analysis.checkers._ast_util import dotted_name, parent_map
+
+_BASE_NAMES = {"BaseException", "KeyboardInterrupt", "SystemExit",
+               "GeneratorExit"}
+
+_LOOP_FN_RE = re.compile(
+    r"^(run|serve|supervise|main|recover\w*|watch\w*|\w*_loop|\w*_beat)$")
+
+_EXIT_CALLS = {"sys.exit", "os._exit", "os.abort"}
+
+
+def _clause_names(handler: ast.ExceptHandler) -> List[str]:
+    """Exception names a handler clause catches ('' for bare except)."""
+    t = handler.type
+    if t is None:
+        return [""]
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return [dotted_name(e).rsplit(".", 1)[-1] for e in elts]
+
+
+def _catches_interrupts(handler: ast.ExceptHandler) -> bool:
+    return any(n == "" or n in _BASE_NAMES for n in _clause_names(handler))
+
+
+def _body_escapes(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body re-raise or exit (vs. swallow and carry
+    on)? Conservative: any raise/return/break anywhere in the body
+    counts — conditional re-raise is the catch-log-reraise idiom."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+            return True
+        if isinstance(node, ast.Call) and dotted_name(node.func) in _EXIT_CALLS:
+            return True
+    return False
+
+
+def _is_silent_swallow(handler: ast.ExceptHandler) -> bool:
+    """Body is nothing but pass/continue (and a docstring-less spine):
+    the failure leaves no trace at all."""
+    return all(isinstance(s, (ast.Pass, ast.Continue)) for s in handler.body)
+
+
+def _enclosing_function(node: ast.AST, parents) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _inside_while(node: ast.AST, parents, stop_at: ast.AST) -> bool:
+    """Is ``node`` (a Try) directly in a while loop's body, walking up
+    no further than the enclosing function?"""
+    cur = parents.get(node)
+    while cur is not None and cur is not stop_at:
+        if isinstance(cur, ast.While):
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        cur = parents.get(cur)
+    return False
+
+
+@register
+class SwallowedInterrupt(Checker):
+    id = "RF006"
+    name = "swallowed-interrupt"
+    severity = "error"
+    rationale = ("a broad except that neither re-raises nor exits eats "
+                 "KeyboardInterrupt/SystemExit — supervise and worker "
+                 "loops become unkillable and recovery paths never run")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        parents = parent_map(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            fn = _enclosing_function(node, parents)
+            for handler in node.handlers:
+                if _catches_interrupts(handler):
+                    if not _body_escapes(handler):
+                        clause = ", ".join(n or "bare except"
+                                           for n in _clause_names(handler))
+                        findings.append(self.finding(
+                            ctx, handler,
+                            f"handler for `{clause}` swallows the "
+                            f"interrupt hierarchy (no re-raise, no "
+                            f"return/break/exit) — Ctrl-C, SystemExit and "
+                            f"preemption SIGTERM handlers die here; "
+                            f"re-raise after cleanup or narrow to "
+                            f"Exception"))
+                    continue
+                if (fn is not None
+                        and _LOOP_FN_RE.match(fn.name)
+                        and "Exception" in _clause_names(handler)
+                        and _is_silent_swallow(handler)
+                        and _inside_while(node, parents, fn)):
+                    findings.append(self.finding(
+                        ctx, handler,
+                        f"`except Exception: pass` inside `{fn.name}`'s "
+                        f"while loop swallows every failure silently — "
+                        f"a long-running loop must log/count what it "
+                        f"absorbs (or justify-suppress)",
+                        severity="warning"))
+        return findings

@@ -20,6 +20,12 @@ worker within one lease TTL. ``reap_stale(max_age_s)`` is the janitor
 half: once a lease is several TTLs old the corpse's registration,
 timestamp and pending-query queue are deleted outright (counted in
 telemetry as ``bus.reaped_workers``), so dead ids stop accumulating.
+
+Chaos hooks (docs/chaos.md): ``bus.add_query`` (drop/delay a fan-out
+message), ``bus.put_prediction`` (drop/delay a reply) and
+``bus.heartbeat`` (skip a lease refresh — how scenarios simulate a
+stalled or dead worker without killing the thread). All keyed by
+worker id; inert no-ops unless ``RAFIKI_CHAOS`` is set.
 """
 
 from __future__ import annotations
@@ -32,6 +38,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from collections import deque
 
 from rafiki_tpu import telemetry
+from rafiki_tpu.chaos import hook as _chaos
 
 
 class InProcBus:
@@ -82,6 +89,8 @@ class InProcBus:
                 self._depth = max(0, self._depth - q.qsize())
 
     def heartbeat(self, job_id: str, worker_id: str) -> None:
+        if _chaos("bus.heartbeat", worker_id) == "skip":
+            return  # injected missed beat: the lease ages as if dead
         with self._lock:
             if worker_id in self._workers.get(job_id, ()):  # never resurrect
                 self._worker_ts[(job_id, worker_id)] = time.monotonic()
@@ -127,6 +136,9 @@ class InProcBus:
     # -- queries -------------------------------------------------------------
 
     def add_query(self, worker_id: str, query_id: str, query: Any) -> None:
+        if _chaos("bus.add_query", worker_id) == "drop":
+            telemetry.inc("bus.queries_dropped_chaos")
+            return  # injected loss: the gather just sees one fewer reply
         with self._lock:
             q = self._queues.get(worker_id)
             if q is not None:
@@ -174,6 +186,8 @@ class InProcBus:
     # -- predictions ---------------------------------------------------------
 
     def put_prediction(self, query_id: str, worker_id: str, prediction: Any) -> None:
+        if _chaos("bus.put_prediction", worker_id) == "drop":
+            return  # injected reply loss
         with self._pred_cv:
             if query_id in self._expired_set:
                 return  # late answer to a timed-out query: drop, don't leak
@@ -281,6 +295,8 @@ class _MpBus:
             self._queues.pop(worker_id, None)
 
     def heartbeat(self, job_id, worker_id):
+        if _chaos("bus.heartbeat", worker_id) == "skip":
+            return  # injected missed beat (chaos fires in the CALLING process)
         with self._lock:
             if worker_id in self._workers.get(job_id, ()):  # never resurrect
                 self._worker_ts[f"{job_id}|{worker_id}"] = time.time()
@@ -320,6 +336,9 @@ class _MpBus:
         return reaped
 
     def add_query(self, worker_id, query_id, query):
+        if _chaos("bus.add_query", worker_id) == "drop":
+            telemetry.inc("bus.queries_dropped_chaos")
+            return
         with self._lock:
             pending = self._queues.get(worker_id)
             if pending is None:  # dead worker → drop; gather sees n-1
@@ -347,6 +366,8 @@ class _MpBus:
             time.sleep(0.005)
 
     def put_prediction(self, query_id, worker_id, prediction):
+        if _chaos("bus.put_prediction", worker_id) == "drop":
+            return
         with self._lock:
             if query_id in self._expired:
                 return  # late answer to a timed-out query: drop, don't leak

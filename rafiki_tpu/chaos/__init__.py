@@ -1,0 +1,32 @@
+"""Chaos plane: deterministic fault injection + recovery scenarios.
+
+Two halves (docs/chaos.md):
+
+* :mod:`rafiki_tpu.chaos.plane` — the ``RAFIKI_CHAOS``-driven fault
+  registry and the ``hook()``/``decide()`` call-site API threaded
+  through the bus, stores, workers, scheduler and serving path.
+* :mod:`rafiki_tpu.chaos.scenarios` / :mod:`rafiki_tpu.chaos.runner` —
+  the declarative scenario catalog and the runner that stands up an
+  in-proc cluster, injects the scheduled faults and asserts recovery
+  invariants (``python -m rafiki_tpu.chaos run <scenario>``).
+
+Import cost matters: this package is imported by the bus and the
+stores, so only ``plane`` (stdlib + telemetry) loads eagerly; the
+scenario machinery — which pulls in schedulers and models — stays
+behind ``python -m rafiki_tpu.chaos`` / explicit imports.
+"""
+
+from rafiki_tpu.chaos.plane import (  # noqa: F401
+    ENV_VAR,
+    ChaosError,
+    ChaosSpecError,
+    Fault,
+    FaultPlane,
+    active,
+    decide,
+    hook,
+    install,
+    perform,
+    reset_from_env,
+    uninstall,
+)

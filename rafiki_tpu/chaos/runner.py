@@ -1,0 +1,141 @@
+"""Scenario runner: install the fault plane, run the body, report.
+
+One scenario run is: set ``RAFIKI_CHAOS`` (subprocess workers inherit
+it) plus the scenario's extra env, install a freshly parsed
+:class:`FaultPlane` in THIS process, reset telemetry so counter
+invariants read from zero, execute the body in a temp dir, then
+restore everything — env, plane, nothing leaks into the caller. The
+report carries every invariant verdict and the plane's fired-fault
+schedule (the replay-determinism surface: same seed → same schedule).
+
+Telemetry: each run emits a ``chaos.scenario`` span, observes the
+wall-clock into the ``chaos.scenario_s`` histogram and — for scenarios
+that recover from a fault rather than merely surface one — the time
+into ``chaos.recovery_s``. Injected-fault counters (``chaos.injected``
+and per site.mode) are incremented by the plane itself as faults fire.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import time
+import traceback
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from rafiki_tpu import telemetry
+from rafiki_tpu.chaos.plane import ENV_VAR, FaultPlane, install, uninstall
+from rafiki_tpu.chaos.scenarios import SCENARIOS
+
+# Scenarios whose pass means "the system RECOVERED" (vs. "the failure
+# surfaced correctly"): their duration feeds the recovery histogram.
+_RECOVERY_SCENARIOS = frozenset({
+    "kill-mid-trial-resume", "kill-mid-pack-resume",
+    "checkpoint-write-failure", "drain-under-load",
+})
+
+
+@dataclasses.dataclass
+class CheckResult:
+    name: str
+    ok: bool
+    detail: str = ""
+
+
+@dataclasses.dataclass
+class ScenarioReport:
+    name: str
+    passed: bool
+    checks: List[CheckResult]
+    schedule: List[tuple]          # fired faults: (site, mode, hit, key)
+    duration_s: float
+    error: Optional[str] = None    # traceback if the body raised
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "passed": self.passed,
+            "duration_s": round(self.duration_s, 3),
+            "checks": [dataclasses.asdict(c) for c in self.checks],
+            "schedule": [list(s) for s in self.schedule],
+            "error": self.error,
+        }
+
+
+def _set_env(values: Dict[str, str]) -> Dict[str, Optional[str]]:
+    saved: Dict[str, Optional[str]] = {}
+    for k, v in values.items():
+        saved[k] = os.environ.get(k)
+        os.environ[k] = v
+    return saved
+
+
+def _restore_env(saved: Dict[str, Optional[str]]) -> None:
+    for k, old in saved.items():
+        if old is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = old
+
+
+def run_scenario(name: str) -> ScenarioReport:
+    sc = SCENARIOS.get(name)
+    if sc is None:
+        raise KeyError(f"unknown chaos scenario {name!r}; "
+                       f"one of {sorted(SCENARIOS)}")
+    checks: List[CheckResult] = []
+
+    def check(cname: str, ok, detail="") -> None:
+        checks.append(CheckResult(cname, bool(ok), str(detail)))
+
+    plane = FaultPlane.from_spec(sc.spec)  # parse FIRST: typos fail loudly
+    saved = _set_env(dict(sc.env, **{ENV_VAR: sc.spec}))
+    install(plane)
+    telemetry.reset()
+    error: Optional[str] = None
+    t0 = time.monotonic()
+    try:
+        with telemetry.span("chaos.scenario", scenario=name):
+            with tempfile.TemporaryDirectory(prefix=f"chaos-{name}-") as td:
+                sc.fn(Path(td), check)
+    except Exception:
+        error = traceback.format_exc()
+    finally:
+        _restore_env(saved)
+        uninstall()
+    duration = time.monotonic() - t0
+    telemetry.observe("chaos.scenario_s", duration)
+    if name in _RECOVERY_SCENARIOS:
+        telemetry.observe("chaos.recovery_s", duration)
+    passed = error is None and bool(checks) and all(c.ok for c in checks)
+    return ScenarioReport(name=name, passed=passed, checks=checks,
+                          schedule=plane.schedule(), duration_s=duration,
+                          error=error)
+
+
+def run_scenarios(names: Optional[List[str]] = None) -> List[ScenarioReport]:
+    return [run_scenario(n) for n in (names or sorted(SCENARIOS))]
+
+
+def format_report(report: ScenarioReport) -> str:
+    lines = [f"{'PASS' if report.passed else 'FAIL'}  {report.name}  "
+             f"({report.duration_s:.1f}s)"]
+    for c in report.checks:
+        mark = "ok " if c.ok else "FAIL"
+        tail = f"  -- {c.detail}" if (c.detail and not c.ok) else ""
+        lines.append(f"  [{mark}] {c.name}{tail}")
+    if report.schedule:
+        lines.append(f"  injected ({len(report.schedule)} faults):")
+        shown = report.schedule[:10]
+        for site, mode, hit, key in shown:
+            lines.append(f"    {site}:{mode} hit={hit} key={key!r}")
+        if len(report.schedule) > len(shown):
+            lines.append(f"    ... {len(report.schedule) - len(shown)} more")
+    else:
+        lines.append("  injected: (none fired)")
+    if report.error:
+        lines.append("  scenario raised:")
+        lines.extend(f"    {line}" for line in report.error.splitlines())
+    return "\n".join(lines)

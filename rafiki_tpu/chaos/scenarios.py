@@ -1,0 +1,462 @@
+"""The recovery scenario catalog (docs/chaos.md).
+
+Each scenario is a declarative bundle: a ``RAFIKI_CHAOS`` fault spec,
+extra environment (inherited by subprocess workers), and a body that
+stands up a real in-proc cluster — sqlite meta store, params store,
+bus, subprocess or thread workers — lets the injected faults land, and
+asserts the recovery invariants through ``check()``. The runner
+(runner.py) owns env install/teardown, telemetry, and reporting; a
+scenario body only builds the cluster and checks invariants.
+
+Scenario bodies import the framework lazily: the CLI must be able to
+pin the jax platform (``honor_env_platform``) before anything pulls in
+jax (analysis rule RF001).
+
+The catalog:
+
+=============================  =============================================
+kill-mid-trial-resume          worker SIGKILLs itself at epoch N mid-trial;
+                               the supervise loop respawns, the replacement
+                               adopts and resumes from the epoch-N
+                               checkpoint; no lost/duplicated trial rows
+kill-mid-pack-resume           the ISSUE acceptance scenario: a k=4 packed
+                               run killed mid-pack resumes ALL members from
+                               per-epoch slice checkpoints, and each
+                               resumed trial's final params bit-match an
+                               unfaulted serial run
+straggler-quorum               one of three serving replicas stuck 3s per
+                               forward; quorum gather answers fast without
+                               timeout errors, hedging past the straggler
+drain-under-load               gateway drain under background load with
+                               injected frontend latency: flushes inflight,
+                               sheds new work as ``draining``
+predictor-outage-surfaces      every bus heartbeat skipped: the bounded
+                               stale-lease grace serves through a hiccup,
+                               then a real outage raises RuntimeError
+checkpoint-write-failure       every checkpoint write errors; the trial
+                               still completes (resumability lost, work
+                               kept) and the failure is counted
+=============================  =============================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+# check(name, ok, detail) — the invariant-recording callback the runner
+# passes into every scenario body.
+CheckFn = Callable[..., None]
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    description: str
+    spec: str                      # RAFIKI_CHAOS value for the run
+    fn: Callable[..., None]        # fn(tmp: Path, check: CheckFn)
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+SCENARIOS: Dict[str, Scenario] = {}
+
+
+def scenario(name: str, description: str, spec: str,
+             env: Optional[Dict[str, str]] = None):
+    def register(fn):
+        SCENARIOS[name] = Scenario(name=name, description=description,
+                                   spec=spec, fn=fn, env=dict(env or {}))
+        return fn
+    return register
+
+
+# ---------------------------------------------------------------------------
+# Shared fixtures
+# ---------------------------------------------------------------------------
+
+# A 3-epoch MLP whose only shape knob is fixed: every proposal shares a
+# packing key (k trials vmap into one program) and ``seed`` defaults to
+# 0, so a fresh model with a trial's knobs retrains bit-identically —
+# the reference run the resume invariants compare against.
+FF_SOURCE = b"""
+from rafiki_tpu.model.base import JaxModel
+from rafiki_tpu.model.knobs import FixedKnob, FloatKnob
+from rafiki_tpu.models.ff import _Mlp
+
+class ChaosFF(JaxModel):
+    @staticmethod
+    def get_knob_config():
+        return {
+            "hidden_units": FixedKnob(16),
+            "learning_rate": FloatKnob(1e-3, 3e-2, is_exp=True),
+            "batch_size": FixedKnob(32),
+            "epochs": FixedKnob(3),
+        }
+
+    def build_module(self, num_classes, input_shape):
+        return _Mlp(hidden_layers=1,
+                    hidden_units=int(self.knobs["hidden_units"]),
+                    num_classes=num_classes)
+"""
+
+TRAIN = "synthetic://images?classes=5&n=128&w=8&h=8&seed=0"
+VAL = "synthetic://images?classes=5&n=64&w=8&h=8&seed=1"
+
+JOB = "chaosjob"
+
+
+def _train_env(tmp):
+    from rafiki_tpu.store import MetaStore, ParamsStore
+
+    store = MetaStore(tmp / "meta.sqlite3")
+    params = ParamsStore(tmp / "params")
+    model = store.create_model("chaosff", "IMAGE_CLASSIFICATION", None,
+                               FF_SOURCE, "ChaosFF")
+    return store, params, model
+
+
+def _make_job(store, model, budget):
+    job = store.create_train_job("chaosapp", "IMAGE_CLASSIFICATION", None,
+                                 TRAIN, VAL, budget)
+    store.create_sub_train_job(job["id"], model["id"])
+    return job
+
+
+def _check_rows(check, store, job_id, expect: int):
+    """The lost/duplicated-rows invariant shared by the kill scenarios:
+    exactly ``expect`` trial rows (the atomic budget claim survived the
+    crash — no slot leaked, no trial double-created), all COMPLETED."""
+    trials = store.get_trials_of_train_job(job_id)
+    check("exact_trial_rows", len(trials) == expect,
+          f"{len(trials)} rows for budget {expect}")
+    bad = [t["id"] for t in trials if t["status"] != "COMPLETED"]
+    check("all_trials_completed", not bad, f"not completed: {bad}")
+    check("no_duplicate_rows",
+          len({t["id"] for t in trials}) == len(trials), "duplicate ids")
+    return trials
+
+
+def _params_match_serial(check, params, trials):
+    """Bit-match invariant: each resumed trial's persisted params equal
+    a fresh unfaulted serial train() with the same knobs (seed knob
+    defaults identically), leaf for leaf."""
+    import numpy as np
+
+    from rafiki_tpu.model.base import load_model_class
+    from rafiki_tpu.utils.serial import load_pytree
+
+    cls = load_model_class(FF_SOURCE, "ChaosFF")
+
+    def leaves(blob: bytes):
+        import pickle
+
+        return load_pytree(pickle.loads(blob)["packed"])
+
+    def flat(d, prefix=""):
+        for k in sorted(d):
+            v = d[k]
+            if isinstance(v, dict):
+                yield from flat(v, f"{prefix}{k}/")
+            else:
+                yield f"{prefix}{k}", v
+
+    for t in trials:
+        ref = cls(**t["knobs"])
+        ref.train(TRAIN)
+        got = dict(flat(leaves(params.load(t["params_id"]))))
+        want = dict(flat(leaves(ref.dump_parameters())))
+        ref.destroy()
+        same = (set(got) == set(want)
+                and all(np.array_equal(got[k], want[k]) for k in want))
+        check(f"params_match_serial:{t['id'][:8]}", same,
+              "resumed params differ from unfaulted serial run")
+
+
+def _no_corrupt_checkpoints(check, params, trials):
+    """Completed trials must have their mid-trial checkpoints swept
+    (they are superseded by final params), and every persisted params
+    blob must load — a torn write would throw here."""
+    leftovers = []
+    for t in trials:
+        if params.latest_checkpoint(t["id"]) is not None:
+            leftovers.append(t["id"])
+        params.load(t["params_id"])  # digest-verified read; raises if torn
+    check("no_stale_checkpoints", not leftovers,
+          f"checkpoints outlived completion: {leftovers}")
+
+
+# ---------------------------------------------------------------------------
+# Train-path scenarios (real subprocess workers)
+# ---------------------------------------------------------------------------
+
+@scenario(
+    "kill-mid-trial-resume",
+    "SIGKILL the worker after epoch 1 of a 3-epoch trial; the respawned "
+    "worker must adopt and resume from the epoch-1 checkpoint, then "
+    "finish the remaining budget — no lost or duplicated trial rows.",
+    spec="seed=7;worker.epoch:kill:after=1:times=1:unless=-r",
+    env={"RAFIKI_CHECKPOINT_EVERY": "1", "RAFIKI_WORKER_MAX_RESTARTS": "3",
+         "RAFIKI_WORKER_RESTART_BACKOFF_S": "0.2"},
+)
+def kill_mid_trial_resume(tmp, check: CheckFn) -> None:
+    from rafiki_tpu.scheduler import ProcessScheduler
+
+    store, params, model = _train_env(tmp)
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 2})
+    sched = ProcessScheduler(store, params)
+    result = sched.run_train_job(job["id"], n_workers=1,
+                                 advisor_kind="random", platform="cpu")
+    check("job_completed", result.status == "COMPLETED", result.errors)
+    trials = _check_rows(check, store, job["id"], expect=2)
+    # The kill really happened and recovery really ran: at least one
+    # trial finished under the RESPAWNED worker (its id carries the
+    # restart suffix the unless=-r filter keys off).
+    resumed = [t for t in trials if "-r" in (t["worker_id"] or "")]
+    check("trial_finished_by_respawned_worker", len(resumed) >= 1,
+          f"worker ids: {[t['worker_id'] for t in trials]}")
+    _no_corrupt_checkpoints(check, params, trials)
+
+
+@scenario(
+    "kill-mid-pack-resume",
+    "The acceptance scenario: a k=4 packed run SIGKILLed mid-pack must "
+    "resume ALL four trials from their per-epoch slice checkpoints; "
+    "resumed final params bit-match an unfaulted serial run.",
+    spec="seed=7;worker.epoch:kill:after=1:times=1:unless=-r",
+    env={"RAFIKI_CHECKPOINT_EVERY": "1", "RAFIKI_TRIAL_PACK": "4",
+         "RAFIKI_WORKER_MAX_RESTARTS": "3",
+         "RAFIKI_WORKER_RESTART_BACKOFF_S": "0.2"},
+)
+def kill_mid_pack_resume(tmp, check: CheckFn) -> None:
+    from rafiki_tpu.scheduler import ProcessScheduler
+
+    store, params, model = _train_env(tmp)
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 4})
+    sched = ProcessScheduler(store, params)
+    result = sched.run_train_job(job["id"], n_workers=1,
+                                 advisor_kind="random", platform="cpu")
+    check("job_completed", result.status == "COMPLETED", result.errors)
+    trials = _check_rows(check, store, job["id"], expect=4)
+    resumed = [t for t in trials if "-r" in (t["worker_id"] or "")]
+    check("all_trials_resumed_by_respawned_worker", len(resumed) == 4,
+          f"worker ids: {[t['worker_id'] for t in trials]}")
+    _no_corrupt_checkpoints(check, params, trials)
+    _params_match_serial(check, params, trials)
+
+
+@scenario(
+    "checkpoint-write-failure",
+    "Every mid-trial checkpoint write fails (injected store error). "
+    "A checkpoint is an optimization: the trial must still COMPLETE — "
+    "only its resumability is lost — and the failure must be counted.",
+    spec="seed=7;store.params_write:error:match=_ckpt_",
+    env={"RAFIKI_CHECKPOINT_EVERY": "1"},
+)
+def checkpoint_write_failure(tmp, check: CheckFn) -> None:
+    from rafiki_tpu import telemetry
+    from rafiki_tpu.scheduler import LocalScheduler
+
+    store, params, model = _train_env(tmp)
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 1})
+    sched = LocalScheduler(store, params)
+    result = sched.run_train_job(job["id"], n_workers=1,
+                                 advisor_kind="random")
+    check("job_completed", result.status == "COMPLETED", result.errors)
+    trials = _check_rows(check, store, job["id"], expect=1)
+    check("write_failures_counted",
+          telemetry.get_counter("worker.checkpoint_write_failed") >= 1.0,
+          "no worker.checkpoint_write_failed increments")
+    # Final params take the non-checkpoint path: unaffected, loadable.
+    params.load(trials[0]["params_id"])
+
+
+# ---------------------------------------------------------------------------
+# Serving-path scenarios (in-proc bus + thread workers)
+# ---------------------------------------------------------------------------
+
+class _ConstModel:
+    """Fixed prob-vector stand-in: the serving scenarios exercise the
+    gather/drain machinery, not the model."""
+
+    def __init__(self, vec):
+        self.vec = list(vec)
+
+    def predict(self, queries):
+        return [self.vec for _ in queries]
+
+
+class _ServingCluster:
+    def __init__(self, n_workers: int, job: str = JOB):
+        from rafiki_tpu.bus import InProcBus
+        from rafiki_tpu.worker.inference import InferenceWorker
+
+        self.bus = InProcBus()
+        self.job = job
+        self.stop = threading.Event()
+        self.threads = []
+        for i in range(n_workers):
+            w = InferenceWorker(self.bus, job, f"w{i}",
+                                _ConstModel([0.6, 0.4]),
+                                stop_event=self.stop)
+            th = threading.Thread(target=w.run, daemon=True,
+                                  name=f"chaos-iw-w{i}")
+            self.threads.append(th)
+            th.start()
+        deadline = time.monotonic() + 10
+        while len(self.bus.get_workers(job)) < n_workers:
+            if time.monotonic() >= deadline:
+                raise RuntimeError("inference workers never registered")
+            time.sleep(0.005)
+
+    def close(self):
+        self.stop.set()
+        for th in self.threads:
+            th.join(timeout=5)
+
+
+@scenario(
+    "straggler-quorum",
+    "One of three serving replicas is stuck 3s per forward. Quorum "
+    "gather (min_replies=2) must answer every request fast, with no "
+    "timeout errors, hedging past the straggler.",
+    spec="seed=7;inference.forward:delay:delay=3:match=w2",
+)
+def straggler_quorum(tmp, check: CheckFn) -> None:
+    from rafiki_tpu import chaos
+    from rafiki_tpu.gateway import Gateway, GatewayConfig
+    from rafiki_tpu.predictor import Predictor
+
+    cluster = _ServingCluster(3)
+    try:
+        predictor = Predictor(cluster.bus, JOB, timeout_s=8.0)
+        gw = Gateway(predictor, GatewayConfig(min_replies=2,
+                                              hedge_grace_s=0.1))
+        t0 = time.monotonic()
+        outs = gw.predict([[1.0], [2.0]])
+        elapsed = time.monotonic() - t0
+        check("all_queries_answered",
+              len(outs) == 2 and all(
+                  not (isinstance(o, dict) and "error" in o) for o in outs),
+              f"outputs: {outs}")
+        check("quorum_faster_than_straggler", elapsed < 2.5,
+              f"gather took {elapsed:.2f}s against a 3s straggler")
+        stats = gw.stats()
+        check("no_gather_timeouts", stats["timeouts"] == 0, stats["timeouts"])
+        check("straggler_hedged", stats["hedged"] >= 1, stats["hedged"])
+        plane = chaos.active()
+        fired = [] if plane is None else plane.schedule()
+        check("straggler_fault_fired",
+              any(site == "inference.forward" and "w2" in key
+                  for site, _mode, _hit, key in fired),
+              f"schedule: {fired}")
+    finally:
+        cluster.close()
+
+
+@scenario(
+    "drain-under-load",
+    "Drain the gateway while background requests (with injected "
+    "frontend latency) hold inflight slots: drain must flush them "
+    "within its timeout and every post-drain request must shed.",
+    spec="seed=7;gateway.predict:delay:delay=0.3:times=6",
+)
+def drain_under_load(tmp, check: CheckFn) -> None:
+    from rafiki_tpu.gateway import Gateway, GatewayConfig, ShedError
+    from rafiki_tpu.predictor import Predictor
+
+    cluster = _ServingCluster(1)
+    try:
+        predictor = Predictor(cluster.bus, JOB, timeout_s=8.0)
+        gw = Gateway(predictor, GatewayConfig(max_inflight=2, max_queue=8))
+        outcomes: List[str] = []
+        lock = threading.Lock()
+
+        def fire():
+            try:
+                gw.predict([[1.0]])
+                out = "ok"
+            except ShedError as e:
+                out = f"shed:{e.reason}"
+            with lock:
+                outcomes.append(out)
+
+        threads = [threading.Thread(target=fire) for _ in range(6)]
+        for th in threads:
+            th.start()
+        time.sleep(0.15)  # let the first wave hold inflight slots
+        drained = gw.drain(timeout=10.0)
+        for th in threads:
+            th.join(timeout=15)
+        check("drain_flushed_inflight", drained, "drain() timed out")
+        check("inflight_zero_after_drain", gw.admission.inflight == 0,
+              gw.admission.inflight)
+        check("some_requests_served", outcomes.count("ok") >= 1, outcomes)
+        check("no_request_lost", len(outcomes) == 6, outcomes)
+        try:
+            gw.predict([[1.0]])
+            check("post_drain_request_shed", False, "predict succeeded")
+        except ShedError as e:
+            check("post_drain_request_shed", e.reason == "draining", e.reason)
+    finally:
+        cluster.close()
+
+
+@scenario(
+    "predictor-outage-surfaces",
+    "Every bus heartbeat skipped. Inside the bounded stale grace the "
+    "predictor still serves (counted fallback); past it the outage "
+    "surfaces as RuntimeError, not per-query timeouts.",
+    spec="seed=7;bus.heartbeat:skip",
+)
+def predictor_outage_surfaces(tmp, check: CheckFn) -> None:
+    from rafiki_tpu import chaos, telemetry
+    from rafiki_tpu.bus import InProcBus
+    from rafiki_tpu.predictor import Predictor
+
+    bus = InProcBus()
+    for w in ("w0", "w1"):
+        bus.add_worker(JOB, w)
+    stop = threading.Event()
+
+    def beat():
+        while not stop.wait(0.05):
+            for w in ("w0", "w1"):
+                bus.heartbeat(JOB, w)  # chaos skips every one
+
+    th = threading.Thread(target=beat, daemon=True)
+    th.start()
+    try:
+        ttl = 0.4
+        predictor = Predictor(bus, JOB, timeout_s=1.0, worker_ttl_s=ttl)
+        # Phase 1 — a hiccup: leases ~1.5×TTL old, inside the 2×TTL
+        # grace. The bounded fallback serves the full set and counts.
+        time.sleep(1.5 * ttl)
+        graced = predictor.live_workers()
+        check("grace_window_serves", set(graced) == {"w0", "w1"}, graced)
+        check("fallback_counted",
+              telemetry.get_counter("predictor.stale_lease_fallback") >= 1.0,
+              "no predictor.stale_lease_fallback increments")
+        # Phase 2 — an outage: leases beyond the grace bound. Empty
+        # fan-out set, and predict() raises instead of masquerading
+        # the outage as slow answers.
+        time.sleep(1.0 * ttl)
+        check("outage_set_empty", predictor.live_workers() == [], "not empty")
+        try:
+            predictor.predict([[1.0]])
+            check("outage_raises", False, "predict succeeded")
+        except RuntimeError as e:
+            check("outage_raises", "no live inference workers" in str(e), e)
+        check("outage_counted",
+              telemetry.get_counter("predictor.no_live_workers") >= 1.0,
+              "no predictor.no_live_workers increments")
+        plane = chaos.active()
+        fired = [] if plane is None else plane.schedule()
+        check("heartbeats_skipped",
+              sum(1 for site, mode, _h, _k in fired
+                  if site == "bus.heartbeat" and mode == "skip") >= 2,
+              f"schedule: {fired}")
+    finally:
+        stop.set()
+        th.join(timeout=2)

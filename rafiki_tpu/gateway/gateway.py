@@ -36,7 +36,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import threading
 
-from rafiki_tpu import telemetry
+from rafiki_tpu import chaos, telemetry
 from rafiki_tpu.gateway.admission import AdmissionController, ShedError
 from rafiki_tpu.gateway.breaker import CircuitBreaker
 
@@ -136,6 +136,12 @@ class Gateway:
         telemetry.inc("gateway.admitted")
         if waited:
             telemetry.observe("gateway.queue_wait_s", waited)
+        # Chaos: an injected delay here is a frontend latency spike that
+        # eats into the request's own deadline — it exercises the
+        # deadline-aware gather (the predictor gets whatever budget is
+        # left) while the request holds an inflight slot, which is what
+        # drain-under-load scenarios need to stretch.
+        chaos.hook("gateway.predict", self.predictor.job_id)
         t0 = time.monotonic()
         try:
             workers, quorum = self._route()

@@ -350,7 +350,8 @@ class JaxModel(BaseModel):
 
     @classmethod
     def train_packed(cls, models: List["JaxModel"], dataset_uri: str,
-                     on_epoch=None) -> List[List[Dict[str, float]]]:
+                     on_epoch=None,
+                     checkpoint_sink=None) -> List[List[Dict[str, float]]]:
         """Train k model instances as ONE vmapped program on one device.
 
         All models must share a packing_key (the caller buckets).
@@ -361,9 +362,19 @@ class JaxModel(BaseModel):
         writes them to each trial's log. ``on_epoch(epoch)`` fires
         after every packed epoch (worker heartbeats).
 
+        ``checkpoint_sink(epoch, make_blobs)``, when given, fires after
+        each epoch BEFORE ``on_epoch``; ``make_blobs()`` materializes k
+        per-trial checkpoint blobs in model order, each identical in
+        format to a serial ``dump_checkpoint`` — sliced out of the live
+        pack (``trial_state(i)`` device views, host copies pipelined)
+        without serializing the stacked state. A packed trial's
+        checkpoint therefore restores through the ordinary serial
+        resume path (docs/trial_packing.md).
+
         Not supported in a pack (callers enforce; asserted here):
         meshes (the trial axis IS the parallelism), checkpoint-resume
-        (``_start_epoch > 0``), masked datasets.
+        (``_start_epoch > 0`` — an interrupted pack member resumes
+        SERIALLY from its slice checkpoint), masked datasets.
         """
         from rafiki_tpu.ops.train import PackedTrainLoop
 
@@ -401,6 +412,9 @@ class JaxModel(BaseModel):
             program_key=fns["program_key"])
 
         histories: List[List[Dict[str, float]]] = [[] for _ in models]
+        arch = (num_classes, tuple(input_shape))
+        planned = epochs * max(1, ds.size // batch_size)
+        portable = _portable_meta(dict(ds.meta))
         for epoch in range(epochs):
             # Serial parity: trial i's shuffle seed is seed_i + epoch,
             # exactly what train() passes to run_epoch.
@@ -408,6 +422,11 @@ class JaxModel(BaseModel):
                                    [m._seed + epoch for m in models])
             for i, mt in enumerate(mts):
                 histories[i].append(dict(mt, epoch=epoch))
+            if checkpoint_sink is not None:
+                checkpoint_sink(
+                    epoch,
+                    lambda e=epoch: cls._packed_checkpoint_blobs(
+                        packed, arch, e, planned, portable))
             if on_epoch is not None:
                 on_epoch(epoch)
 
@@ -417,6 +436,40 @@ class JaxModel(BaseModel):
             m._arch = (num_classes, tuple(input_shape))
             m._epochs_done = epochs - 1
         return histories
+
+    @staticmethod
+    def _packed_checkpoint_blobs(packed, arch, epoch: int, planned_steps,
+                                 dataset_meta) -> List[bytes]:
+        """k serial-format checkpoint blobs out of a live pack.
+
+        The pack is NOT serialized: each trial's state is a device-side
+        slice view (``trial_state(i)`` = ``tree.map(a[i])``), and every
+        slice's device→host copies are kicked off asynchronously before
+        any blob is assembled, so the k transfers overlap instead of
+        serializing k round-trips. Payload keys mirror
+        ``dump_checkpoint`` exactly — ``restore_checkpoint`` cannot
+        tell a pack-sliced snapshot from a serial one.
+        """
+        import jax
+
+        from rafiki_tpu.utils.serial import dump_pytree
+
+        states = [packed.trial_state(i) for i in range(packed.k)]
+        for st in states:
+            for leaf in jax.tree.leaves(st):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+        blobs = []
+        for st in states:
+            payload = {
+                "arch": arch,
+                "state_packed": dump_pytree(st, cast_f32_to_bf16=False),
+                "epoch": epoch,
+                "planned_steps": planned_steps,
+                "dataset_meta": dataset_meta,
+            }
+            blobs.append(pickle.dumps(payload))
+        return blobs
 
     @classmethod
     def evaluate_packed(cls, models: List["JaxModel"], dataset_uri: str) -> List[float]:

@@ -56,6 +56,13 @@ class Predictor:
     # A lease this many TTLs old is a corpse, not a starved worker:
     # reap its registration instead of filtering it forever.
     REAP_TTL_FACTOR = 4.0
+    # Bounded stale-lease grace: when NO lease is fresh, fall back to
+    # workers at most this many TTLs old — a hiccup (GC pause, beat
+    # thread starved behind a compile) shouldn't 503 the job. Strictly
+    # below REAP_TTL_FACTOR: a worker past the grace window is treated
+    # as dead even before the janitor deletes its registration, so an
+    # actual all-workers-dead outage still surfaces as RuntimeError.
+    STALE_GRACE_FACTOR = 2.0
 
     def __init__(self, bus, job_id: str, timeout_s: float = 10.0,
                  worker_ttl_s: float = 3.0,
@@ -77,11 +84,22 @@ class Predictor:
         self.hedge_grace_s = hedge_grace_s
 
     def live_workers(self) -> List[str]:
-        """Reap corpses, then return the fresh-leased worker set."""
+        """Reap corpses, then return the fresh-leased worker set — or,
+        when that set is empty, the BOUNDED stale fallback: workers with
+        a lease younger than ``STALE_GRACE_FACTOR×TTL``. Past that, []:
+        the caller raises and the outage surfaces instead of fanning
+        out to corpses forever (ADVICE round 5)."""
         reap = getattr(self.bus, "reap_stale", None)
         if reap is not None:
             reap(self.REAP_TTL_FACTOR * self.worker_ttl_s, job_id=self.job_id)
-        return self.bus.get_workers(self.job_id, max_age_s=self.worker_ttl_s)
+        fresh = self.bus.get_workers(self.job_id, max_age_s=self.worker_ttl_s)
+        if fresh:
+            return fresh
+        graced = self.bus.get_workers(
+            self.job_id, max_age_s=self.STALE_GRACE_FACTOR * self.worker_ttl_s)
+        if graced:
+            telemetry.inc("predictor.stale_lease_fallback")
+        return graced
 
     def predict(self, queries: List[Any],
                 timeout_s: Optional[float] = None) -> List[Any]:

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from rafiki_tpu import chaos
 from rafiki_tpu.advisor import AdvisorService
 from rafiki_tpu.advisor.app import AdvisorApp
 from rafiki_tpu.constants import ServiceStatus, ServiceType, TrainJobStatus, TrialStatus
@@ -357,6 +358,33 @@ class ProcessScheduler:
         self.store.update_service(service["id"],
                                   status=ServiceStatus.RUNNING.value)
 
+    @staticmethod
+    def _maybe_preempt(g: _WorkerGroup) -> None:
+        """Enact a ``scheduler.preempt`` fault on a running group's
+        leader: ``term`` = SIGTERM, ``kill`` = SIGKILL, ``preempt`` =
+        SIGTERM now with a SIGKILL follow-up after the fault's
+        ``delay`` grace — the maintenance-eviction shape (a real
+        preemption notice gives the process a bounded window to die
+        cleanly before the host yanks it)."""
+        fault = chaos.decide("scheduler.preempt", key=f"w{g.index}")
+        if fault is None or not g.procs:
+            return
+        leader = g.procs[0]
+        events.emit("chaos_preempt", worker_index=g.index, mode=fault.mode)
+        if fault.mode == "kill":
+            leader.kill()
+        elif fault.mode in ("term", "preempt"):
+            leader.terminate()
+            if fault.mode == "preempt":
+                def _kill_after(p=leader, grace=fault.delay_s):
+                    try:
+                        p.wait(timeout=grace)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+
+                threading.Thread(target=_kill_after, daemon=True,
+                                 name=f"chaos-preempt-w{g.index}").start()
+
     def _run_sub_job(self, sub: dict, job: dict, n_workers: int,
                      devices_per_trial: int, advisor_kind: str, platform: str,
                      advisor_url: str, secret: str,
@@ -443,6 +471,11 @@ class ProcessScheduler:
                     continue
                 state = g.state()
                 if state == "running":
+                    # Chaos: simulated preemption/eviction of a live
+                    # group, keyed w<index>, one hit per supervise poll.
+                    # The normal failed→restart→adopt machinery below is
+                    # exactly what the fault must exercise.
+                    self._maybe_preempt(g)
                     continue
                 if state == "ok":
                     # Non-zero follower exits AFTER a clean leader exit

@@ -521,6 +521,15 @@ class MetaStore:
 
     def update_service(self, service_id: str, status: Optional[str] = None,
                        heartbeat: bool = False) -> None:
+        if heartbeat:
+            # Chaos hook: a skipped service heartbeat ages the lease the
+            # orphan sweep (get_orphaned_trials) reads — how scenarios
+            # simulate a wedged train worker without killing it. Status
+            # updates are never skipped: they are state, not liveness.
+            from rafiki_tpu.chaos import hook as _chaos
+
+            if _chaos("store.heartbeat", service_id) == "skip":
+                heartbeat = False
         with self._conn() as c:
             if status is not None:
                 c.execute("UPDATE services SET status=? WHERE id=?", (status, service_id))

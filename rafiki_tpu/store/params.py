@@ -10,6 +10,12 @@ used by the worker for resumable long trials.
 Blobs are whatever the model's ``dump_parameters`` returned (for
 JaxModel: a pickled dict holding flax msgpack bytes — a host-side
 pytree snapshot, cheap to write from one `jax.device_get`).
+
+Chaos hook: ``store.params_write`` fires before each write — ``delay``
+simulates a slow disk, ``error`` a failing one (raises
+:class:`rafiki_tpu.chaos.ChaosError`, an OSError). Keyed by params id
+so scenarios can target checkpoint writes (``match=_ckpt_``) apart
+from final params. Inert unless ``RAFIKI_CHAOS`` is set.
 """
 
 from __future__ import annotations
@@ -19,6 +25,8 @@ import os
 import uuid
 from pathlib import Path
 from typing import List, Optional
+
+from rafiki_tpu.chaos import hook as _chaos
 
 
 class ParamsStore:
@@ -38,6 +46,7 @@ class ParamsStore:
 
     def save(self, blob: bytes, params_id: Optional[str] = None) -> str:
         params_id = params_id or uuid.uuid4().hex
+        _chaos("store.params_write", params_id)  # delay=slow disk, error=failed write
         path = self._path(params_id)
         tmp = path.with_suffix(".tmp")
         digest = hashlib.sha256(blob).hexdigest().encode()

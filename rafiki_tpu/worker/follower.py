@@ -108,6 +108,7 @@ class FollowerWorker:
                     model.train(job["train_dataset_uri"])
                     model.evaluate(job["val_dataset_uri"])
                     self.mirrored += 1
+                # lint: disable=RF006 — leader hits the identical error and owns reporting; the follower only keeps collectives paired
                 except Exception:
                     # The leader owns error handling; our job was only
                     # to keep the collectives paired. If the model
@@ -119,8 +120,9 @@ class FollowerWorker:
                     try:
                         if model is not None:
                             model.destroy()
+                    # lint: disable=RF006 — user-model destroy() must not kill the group; nothing to recover
                     except Exception:
-                        pass  # user-model destroy() must not kill the group
+                        pass
             if ran_one:
                 continue  # look again immediately: the next trial may be up
             sub = self.store.get_sub_train_job(self.sub_id)

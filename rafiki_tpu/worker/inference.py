@@ -17,7 +17,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
-from rafiki_tpu import telemetry
+from rafiki_tpu import chaos, telemetry
 from rafiki_tpu.model.base import BaseModel
 
 
@@ -62,6 +62,11 @@ class InferenceWorker:
                 qids = [qid for qid, _ in items]
                 queries = [q for _, q in items]
                 try:
+                    # Chaos: a delay here is a latency spike / stuck
+                    # replica (the lease stays fresh — the beat thread
+                    # runs on); an error is a poisoned forward. Both
+                    # exercise the gateway's quorum + breaker paths.
+                    chaos.hook("inference.forward", self.worker_id)
                     with telemetry.span("inference.forward",
                                         worker_id=self.worker_id):
                         preds = self._predict(queries)

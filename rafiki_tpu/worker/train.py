@@ -25,7 +25,7 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional, Protocol
 
-from rafiki_tpu import telemetry
+from rafiki_tpu import chaos, telemetry
 from rafiki_tpu.constants import BudgetType, TrainJobStatus, TrialStatus
 from rafiki_tpu.model.base import BaseModel, load_model_class
 from rafiki_tpu.model.knobs import Knobs, knob_config_signature
@@ -249,14 +249,35 @@ class TrainWorker:
                     events.emit("checkpoint_restore_failed", trial_id=tid,
                                 worker_id=self.worker_id,
                                 error=traceback.format_exc(limit=5))
-        if self.checkpoint_every > 0 and hasattr(model, "set_checkpoint_sink"):
-            every = self.checkpoint_every
-
+        # The sink is also the per-epoch chaos hook site (worker.epoch:
+        # kill-at-epoch-N faults), so it gets wired whenever a plane is
+        # active even with checkpointing off.
+        every = self.checkpoint_every
+        if ((every > 0 or chaos.active() is not None)
+                and hasattr(model, "set_checkpoint_sink")):
             def sink(epoch: int, make_blob) -> None:
-                if (epoch + 1) % every == 0:
-                    self.params_store.save_checkpoint(tid, epoch, make_blob())
+                if every > 0 and (epoch + 1) % every == 0:
+                    self._save_checkpoint(tid, epoch, make_blob)
+                # AFTER the write: a kill-at-epoch-N fault lands with
+                # epoch N's checkpoint already durable, which is the
+                # contract resume scenarios assert.
+                chaos.hook("worker.epoch", key=self.worker_id)
 
             model.set_checkpoint_sink(sink)
+
+    def _save_checkpoint(self, tid: str, epoch: int, make_blob) -> None:
+        """Write one mid-trial checkpoint, absorbing write failures: a
+        checkpoint is an optimization, and a full disk (or an injected
+        ``store.params_write`` fault) must cost resumability, not the
+        trial — the training loop has the real result in device memory
+        and must keep going."""
+        try:
+            self.params_store.save_checkpoint(tid, epoch, make_blob())
+        except Exception:
+            telemetry.inc("worker.checkpoint_write_failed")
+            events.emit("checkpoint_write_failed", trial_id=tid, epoch=epoch,
+                        worker_id=self.worker_id,
+                        error=traceback.format_exc(limit=3))
 
     def resume_trial(self, trial_id: str) -> dict:
         """Re-run an interrupted trial, continuing from its newest
@@ -512,9 +533,28 @@ class PackedTrialRunner:
                             w._last_heartbeat = now
                             w.store.update_service(w.service_id, heartbeat=True)
 
+                # Per-epoch checkpoints for the WHOLE pack: each trial
+                # gets its own serial-format checkpoint sliced out of
+                # the live pack, so a killed pack resumes every member
+                # independently (serially) from its newest epoch — the
+                # pack itself is never serialized. Wired whenever a
+                # cadence is set, and whenever a chaos plane is active
+                # (the sink doubles as the worker.epoch fault site, same
+                # as the serial path).
+                every = w.checkpoint_every
+                ckpt_sink = None
+                if every > 0 or chaos.active() is not None:
+                    def ckpt_sink(epoch: int, make_blobs) -> None:
+                        if every > 0 and (epoch + 1) % every == 0:
+                            self._save_pack_checkpoints(rows, epoch, make_blobs)
+                        # AFTER the writes: a kill-at-epoch-N fault lands
+                        # with every member's epoch-N snapshot durable.
+                        chaos.hook("worker.epoch", key=w.worker_id)
+
                 with telemetry.span("trial_pack.train"):
                     histories = w.model_class.train_packed(
-                        models, w.train_uri, on_epoch=heartbeat)
+                        models, w.train_uri, on_epoch=heartbeat,
+                        checkpoint_sink=ckpt_sink)
                 with telemetry.span("trial_pack.evaluate"):
                     scores = w.model_class.evaluate_packed(models, w.val_uri)
         except Exception:
@@ -537,6 +577,8 @@ class PackedTrialRunner:
                     pass
             return k, drained
 
+        # Completed packs supersede their mid-trial checkpoints the same
+        # way serial trials do (_persist deletes them per trial below).
         # Per-trial bookkeeping in creation order — logs, feedback,
         # persistence — indistinguishable from k serial trials.
         for i, (tid, kn) in enumerate(rows):
@@ -557,6 +599,30 @@ class PackedTrialRunner:
                 w._persist(tid, models[i], score)
         telemetry.inc("worker.packed_rounds")
         return k, drained
+
+    def _save_pack_checkpoints(self, rows, epoch: int, make_blobs) -> None:
+        """Write one epoch's per-trial checkpoints for the pack, with
+        the serial path's durability contract: a failed write (full
+        disk, injected ``store.params_write`` fault) costs that trial's
+        resumability, never the pack — training has the real state in
+        device memory and must keep going."""
+        w = self.w
+        try:
+            blobs = make_blobs()
+        except Exception:
+            telemetry.inc("worker.checkpoint_write_failed")
+            events.emit("checkpoint_write_failed", epoch=epoch,
+                        worker_id=w.worker_id, trial_id=rows[0][0],
+                        error=traceback.format_exc(limit=3))
+            return
+        for (tid, _kn), blob in zip(rows, blobs):
+            try:
+                w.params_store.save_checkpoint(tid, epoch, blob)
+            except Exception:
+                telemetry.inc("worker.checkpoint_write_failed")
+                events.emit("checkpoint_write_failed", trial_id=tid,
+                            epoch=epoch, worker_id=w.worker_id,
+                            error=traceback.format_exc(limit=3))
 
 
 class _AsyncSaver:
@@ -606,12 +672,17 @@ class _AsyncSaver:
                 with scope:
                     self._worker._persist(trial_id, model, score)
             except Exception:
-                pass  # _persist already contains failures; never die
+                # _persist already contains failures; the saver thread
+                # must never die — but what it absorbs gets counted
+                # (RF006: a silent swallow in a long-running loop hides
+                # every failure the loop will ever have).
+                telemetry.inc("worker.saver_errors")
             finally:
                 try:
                     model.destroy()
+                # lint: disable=RF006 — a throwing user destroy() must not kill the saver; nothing to recover
                 except Exception:
-                    pass  # a throwing destroy() must not kill the saver
+                    pass
                 self._q.task_done()
 
     def flush(self) -> None:

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Chaos CI smoke: three recovery scenarios, end to end (docs/chaos.md).
+
+Runs the fast core of the chaos catalog through the scenario runner:
+
+  * ``kill-mid-trial-resume`` — a subprocess worker SIGKILLs itself at
+    epoch 1; the respawned worker adopts and resumes from the epoch-1
+    checkpoint; no lost/duplicated trial rows;
+  * ``straggler-quorum`` — one of three serving replicas stuck 3s per
+    forward; quorum gather answers fast, hedging past it;
+  * ``drain-under-load`` — gateway drain with injected frontend latency
+    holding inflight slots: flushes, then sheds as ``draining``.
+
+(The full catalog, including the kill-mid-pack acceptance scenario,
+runs via ``python -m rafiki_tpu.chaos run all`` and tests/test_chaos.py.)
+
+Output: one JSON object on stdout, e.g.
+
+  {"scenarios": 3, "passed": 3, "injected_faults": 7, "wall_s": ...,
+   "reports": [{"name": ..., "passed": true, ...}, ...]}
+
+Exit code: 0 when every scenario's invariants hold; 1 otherwise — this
+is a CI gate (scripts/check_tier1.sh), not just a number printer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCENARIOS = ["kill-mid-trial-resume", "straggler-quorum", "drain-under-load"]
+
+
+def main() -> int:
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()
+
+    from rafiki_tpu.chaos.runner import format_report, run_scenarios
+
+    t0 = time.monotonic()
+    reports = run_scenarios(SCENARIOS)
+    out = {
+        "scenarios": len(reports),
+        "passed": sum(1 for r in reports if r.passed),
+        "injected_faults": sum(len(r.schedule) for r in reports),
+        "wall_s": round(time.monotonic() - t0, 2),
+        "reports": [r.to_dict() for r in reports],
+    }
+    print(json.dumps(out, indent=2))
+    failed = [r for r in reports if not r.passed]
+    for r in failed:
+        print(format_report(r), file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,11 @@ env JAX_PLATFORMS=cpu python scripts/bench_serving.py --smoke > /tmp/_bench_serv
 # store rows, logs, feedback and the trial_pack.* telemetry. ~3s.
 env JAX_PLATFORMS=cpu RAFIKI_TRIAL_PACK=4 python scripts/smoke_trial_pack.py > /tmp/_smoke_trial_pack.json \
   || { echo "TIER1 TRIAL PACK SMOKE FAILED (see /tmp/_smoke_trial_pack.json)"; exit 1; }
+# Chaos smoke: three deterministic fault-injection recovery scenarios
+# (docs/chaos.md) — kill-mid-trial resume, straggler quorum, drain
+# under load. ~10s; fails the gate on any violated recovery invariant.
+env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py > /tmp/_chaos_smoke.json \
+  || { echo "TIER1 CHAOS SMOKE FAILED (see /tmp/_chaos_smoke.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
