@@ -32,7 +32,8 @@ Route table (mirrors the reference's client verbs):
                                      (for process-per-chip workers)
   GET  /                             web admin UI (static SPA)
   GET  /healthz                      liveness
-  GET  /metrics                      telemetry snapshot (read-only JSON)
+  GET  /metrics                      telemetry snapshot (read-only JSON;
+                                     ?format=prom for Prometheus text)
 """
 
 from __future__ import annotations
@@ -180,9 +181,15 @@ class AdminApp:
     def ep_metrics(self, request: Request) -> Response:
         # Read-only process introspection, unauthenticated like
         # /healthz: the snapshot carries timings and counts, never
-        # trial data or credentials.
+        # trial data or credentials. ?format=prom serves the same
+        # snapshot in Prometheus text exposition for scrapers.
         from rafiki_tpu import telemetry
 
+        if request.args.get("format") == "prom":
+            from rafiki_tpu.obs import prom
+
+            return Response(prom.to_prometheus(telemetry.snapshot()),
+                            mimetype="text/plain; version=0.0.4")
         return _json(telemetry.snapshot())
 
     def ep_web_index(self, request: Request) -> Response:

@@ -1,0 +1,124 @@
+"""RF007 leaked-span / hand-rolled timing.
+
+Observability-plane finding (PR 6): the span primitive only measures —
+and only journals — on ``__exit__``. Two ways call sites defeat it:
+
+* **error** — ``telemetry.span(...)`` (or a bare ``span()`` imported
+  from rafiki_tpu.telemetry) called anywhere but as a ``with`` context
+  expression (or handed straight to ``ExitStack.enter_context``). A
+  span that never enters/exits records nothing, flushes nothing to the
+  journal, and — if entered without a paired exit — corrupts the
+  parent stack for everything nested after it.
+* **warning** — an end-minus-start delta ``time.monotonic() - x`` in a
+  module that imports rafiki_tpu.telemetry: such a module already has
+  the primitive whose exits feed ``obs trace``/``obs slowest`` and the
+  goodput ledger, so a hand-rolled delta is timing that observability
+  cannot see. Wrap the region in ``telemetry.span(...)`` — or
+  justify-suppress where the delta feeds a different accounting
+  surface (a ledger bucket charge, a deadline budget, an EWMA).
+
+``rafiki_tpu/telemetry/`` and ``rafiki_tpu/obs/`` are exempt: they
+implement the layer this rule points everyone else at. The
+remaining-budget shape ``deadline - time.monotonic()`` is not a delta
+and is never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+from rafiki_tpu.analysis.checkers._ast_util import dotted_name, parent_map
+
+_EXEMPT_PREFIXES = ("rafiki_tpu.telemetry", "rafiki_tpu.obs")
+
+
+def _span_call_names(tree: ast.Module) -> Set[str]:
+    """Dotted names that resolve to telemetry's span() in this module:
+    always ``*.span`` via a telemetry module alias, plus any bare alias
+    from ``from rafiki_tpu.telemetry import span [as x]``."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "rafiki_tpu.telemetry":
+                for a in node.names:
+                    if a.name == "span":
+                        names.add(a.asname or a.name)
+            elif node.module == "rafiki_tpu":
+                for a in node.names:
+                    if a.name == "telemetry":
+                        names.add(f"{a.asname or a.name}.span")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "rafiki_tpu.telemetry":
+                    names.add(f"{a.asname or a.name}.span")
+    return names
+
+
+def _imports_telemetry(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.startswith("rafiki_tpu.telemetry"):
+                return True
+            if node.module == "rafiki_tpu" and any(
+                    a.name == "telemetry" for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any(a.name.startswith("rafiki_tpu.telemetry")
+                   for a in node.names):
+                return True
+    return False
+
+
+def _is_with_context(call: ast.Call, parents) -> bool:
+    """Is this call a `with` item's context expression, or fed straight
+    to ExitStack.enter_context (the dynamic equivalent)?"""
+    parent = parents.get(call)
+    if isinstance(parent, ast.withitem) and parent.context_expr is call:
+        return True
+    if (isinstance(parent, ast.Call) and call in parent.args
+            and dotted_name(parent.func).endswith("enter_context")):
+        return True
+    return False
+
+
+@register
+class LeakedSpan(Checker):
+    id = "RF007"
+    name = "leaked-span"
+    severity = "error"
+    rationale = ("a span not used as a `with` context never exits — it "
+                 "records nothing, journals nothing, and corrupts the "
+                 "span parent stack; hand-rolled monotonic deltas are "
+                 "timing the observability plane cannot see")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if ctx.module_name.startswith(_EXEMPT_PREFIXES):
+            return []
+        findings: List[Finding] = []
+        parents = parent_map(ctx.tree)
+        span_names = _span_call_names(ctx.tree)
+        has_telemetry = _imports_telemetry(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call) and span_names
+                    and dotted_name(node.func) in span_names
+                    and not _is_with_context(node, parents)):
+                findings.append(self.finding(
+                    ctx, node,
+                    "telemetry.span(...) outside a `with` never exits: "
+                    "no duration recorded, no journal flush, and the "
+                    "span parent stack is corrupted for everything "
+                    "after it — use `with telemetry.span(...):`"))
+            elif (has_telemetry and isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)
+                    and isinstance(node.left, ast.Call)
+                    and dotted_name(node.left.func) == "time.monotonic"):
+                findings.append(self.finding(
+                    ctx, node,
+                    "hand-rolled `time.monotonic() - ...` delta in a "
+                    "telemetry-importing module: invisible to `obs "
+                    "trace`/`obs slowest` — wrap the region in "
+                    "telemetry.span(...) or justify-suppress",
+                    severity="warning"))
+        return findings

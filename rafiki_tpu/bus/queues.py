@@ -10,6 +10,14 @@ Interface (mirrors the reference's Cache verbs, SURVEY.md §2):
   put_prediction(query_id, worker_id, prediction)
   get_predictions(query_id, n, timeout)  — predictor gather-wait
 
+Trace envelopes (docs/observability.md): when a trace context is
+active (or an explicit ``trace`` dict is passed), ``add_query``
+enqueues ``(query_id, query, trace)`` instead of the bare 2-tuple, and
+``pop_queries`` hands the envelope through — the inference worker
+re-binds the trace so its spans/journal records stitch into the same
+end-to-end trace as the gateway's. Untraced messages stay 2-tuples, so
+the wire format is backward compatible in both bus implementations.
+
 Liveness: registration is a LEASE, not a fact. A SIGKILLed worker
 process never runs its ``remove_worker`` cleanup (the reference has
 the same hole: its Redis running-worker set outlives the container),
@@ -39,6 +47,33 @@ from collections import deque
 
 from rafiki_tpu import telemetry
 from rafiki_tpu.chaos import hook as _chaos
+from rafiki_tpu.obs import context as _trace_context
+from rafiki_tpu.obs.journal import journal as _journal
+
+
+def _current_trace() -> Optional[Dict[str, Any]]:
+    """The active trace as a plain picklable envelope field (None when
+    untraced — the message stays a 2-tuple)."""
+    tid = _trace_context.current_trace_id()
+    if tid is None:
+        return None
+    trace: Dict[str, Any] = {"trace_id": tid}
+    parent = telemetry.current_span_id()
+    if parent:
+        trace["parent_span"] = parent
+    return trace
+
+
+def _envelope(query_id: str, query: Any,
+              trace: Optional[Dict[str, Any]]) -> tuple:
+    trace = trace or _current_trace()
+    if trace is None:
+        return (query_id, query)
+    # Journal the fan-out hop so the bus appears in the stitched trace.
+    _journal.record("bus", "add_query", query_id=query_id,
+                    trace_id=trace.get("trace_id"),
+                    parent_span=trace.get("parent_span"))
+    return (query_id, query, trace)
 
 
 class InProcBus:
@@ -101,6 +136,7 @@ class InProcBus:
             ws = self._workers.get(job_id, ())
             if max_age_s is None:
                 return sorted(ws)
+            # lint: disable=RF007 — lease cutoff timestamp, not a duration
             cutoff = time.monotonic() - max_age_s
             return sorted(w for w in ws
                           if self._worker_ts.get((job_id, w), 0.0) >= cutoff)
@@ -113,6 +149,7 @@ class InProcBus:
         Callers pick max_age_s well above the liveness TTL (the
         predictor uses k×TTL): reaping is for corpses, not for workers
         a busy host merely starved for one beat."""
+        # lint: disable=RF007 — lease cutoff timestamp, not a duration
         cutoff = time.monotonic() - max_age_s
         reaped: List[Tuple[str, str]] = []
         with self._lock:
@@ -135,14 +172,16 @@ class InProcBus:
 
     # -- queries -------------------------------------------------------------
 
-    def add_query(self, worker_id: str, query_id: str, query: Any) -> None:
+    def add_query(self, worker_id: str, query_id: str, query: Any,
+                  trace: Optional[Dict[str, Any]] = None) -> None:
         if _chaos("bus.add_query", worker_id) == "drop":
             telemetry.inc("bus.queries_dropped_chaos")
             return  # injected loss: the gather just sees one fewer reply
+        item = _envelope(query_id, query, trace)
         with self._lock:
             q = self._queues.get(worker_id)
             if q is not None:
-                q.put((query_id, query))  # unbounded Queue: put never blocks
+                q.put(item)  # unbounded Queue: put never blocks
                 self._depth += 1
                 depth = self._depth
         if q is not None:  # dead worker → drop; the gather just sees n-1
@@ -159,15 +198,16 @@ class InProcBus:
             return q.qsize() if q is not None else 0
 
     def pop_queries(self, worker_id: str, max_n: int = 64,
-                    timeout: float = 0.1) -> List[Tuple[str, Any]]:
+                    timeout: float = 0.1) -> List[tuple]:
         """Block up to ``timeout`` for the first query, then drain up to
-        max_n without blocking — natural micro-batching for the device."""
+        max_n without blocking — natural micro-batching for the device.
+        Items are ``(qid, query)`` or traced ``(qid, query, trace)``."""
         with self._lock:
             q = self._queues.get(worker_id)
         if q is None:  # not registered (stopped): nothing to serve
             time.sleep(min(timeout, 0.05))
             return []
-        out: List[Tuple[str, Any]] = []
+        out: List[tuple] = []
         try:
             out.append(q.get(timeout=timeout))
         except queue.Empty:
@@ -335,15 +375,16 @@ class _MpBus:
             telemetry.inc("bus.reaped_workers", len(reaped))
         return reaped
 
-    def add_query(self, worker_id, query_id, query):
+    def add_query(self, worker_id, query_id, query, trace=None):
         if _chaos("bus.add_query", worker_id) == "drop":
             telemetry.inc("bus.queries_dropped_chaos")
             return
+        item = _envelope(query_id, query, trace)
         with self._lock:
             pending = self._queues.get(worker_id)
             if pending is None:  # dead worker → drop; gather sees n-1
                 return
-            self._queues[worker_id] = pending + ((query_id, query),)
+            self._queues[worker_id] = pending + (item,)
 
     def queue_depth(self, worker_id):
         """Pending (unpopped) queries for one worker (least-loaded

@@ -68,6 +68,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from rafiki_tpu import telemetry
+from rafiki_tpu.obs.journal import journal as _journal
 
 ENV_VAR = "RAFIKI_CHAOS"
 
@@ -231,6 +232,11 @@ class FaultPlane:
                 self._schedule.append((site, f.mode, f.hits, key))
                 telemetry.inc("chaos.injected")
                 telemetry.inc(f"chaos.injected.{site}.{f.mode}")
+                # Journal the injection: a chaos scenario must be
+                # reconstructible from the journals alone (which process
+                # got hit, at what site, on which hit count).
+                _journal.record("chaos", "injected", site=site,
+                                mode=f.mode, key=key, hit=f.hits)
                 return f
         return None
 
@@ -292,6 +298,11 @@ def perform(fault: Fault) -> str:
     interpret (drop/skip are pure return values)."""
     if fault.mode == "delay":
         time.sleep(fault.delay_s)
+        # An injected stall is downtime by definition: charge it to the
+        # goodput ledger so chaos runs show up as degraded goodput.
+        from rafiki_tpu.obs.ledger import ledger
+
+        ledger.add("downtime_s", fault.delay_s)
     elif fault.mode == "error":
         raise ChaosError(
             f"chaos: injected {fault.site} failure ({fault.describe()})")

@@ -18,6 +18,7 @@ and per site.mode) are incremented by the plane itself as faults fire.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import tempfile
 import time
@@ -28,12 +29,23 @@ from typing import Dict, List, Optional
 from rafiki_tpu import telemetry
 from rafiki_tpu.chaos.plane import ENV_VAR, FaultPlane, install, uninstall
 from rafiki_tpu.chaos.scenarios import SCENARIOS
+from rafiki_tpu.obs import journal as journal_mod
+from rafiki_tpu.obs.journal import journal
 
 # Scenarios whose pass means "the system RECOVERED" (vs. "the failure
 # surfaced correctly"): their duration feeds the recovery histogram.
 _RECOVERY_SCENARIOS = frozenset({
     "kill-mid-trial-resume", "kill-mid-pack-resume",
     "checkpoint-write-failure", "drain-under-load",
+})
+
+# Subprocess-killing scenarios must be reconstructible from the
+# journals ALONE (ISSUE 6 tentpole e): the runner gives each run a
+# journal dir (inherited by workers via RAFIKI_LOG_DIR), then asserts
+# the death/recovery story is readable back out of the merged files —
+# including the flight record the scheduler dumps for the dead worker.
+_JOURNALED_SCENARIOS = frozenset({
+    "kill-mid-trial-resume", "kill-mid-pack-resume",
 })
 
 
@@ -52,6 +64,10 @@ class ScenarioReport:
     schedule: List[tuple]          # fired faults: (site, mode, hit, key)
     duration_s: float
     error: Optional[str] = None    # traceback if the body raised
+    # Last flight-recorder payload dumped during the run (the scenario
+    # tempdir is gone by the time the report is read, so the payload is
+    # carried, not the path). None when nothing dumped.
+    flight_record: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return {
@@ -61,6 +77,10 @@ class ScenarioReport:
             "checks": [dataclasses.asdict(c) for c in self.checks],
             "schedule": [list(s) for s in self.schedule],
             "error": self.error,
+            "flight_record": ({"reason": self.flight_record.get("reason"),
+                               "role": self.flight_record.get("role"),
+                               "pid": self.flight_record.get("pid")}
+                              if self.flight_record else None),
         }
 
 
@@ -94,17 +114,44 @@ def run_scenario(name: str) -> ScenarioReport:
     saved = _set_env(dict(sc.env, **{ENV_VAR: sc.spec}))
     install(plane)
     telemetry.reset()
+    from rafiki_tpu.obs.ledger import ledger
+
+    ledger.reset()  # goodput buckets read from zero, like the counters
+    # The runner's journal gets re-pointed into each scenario's tempdir;
+    # remember where it was so nothing leaks into the caller.
+    prev_journal_dir = journal.log_dir if journal.configured else None
+    prev_journal_role = journal.role
+    flight: Optional[dict] = None
     error: Optional[str] = None
     t0 = time.monotonic()
     try:
         with telemetry.span("chaos.scenario", scenario=name):
             with tempfile.TemporaryDirectory(prefix=f"chaos-{name}-") as td:
-                sc.fn(Path(td), check)
+                log_dir = Path(td) / "obs"
+                saved_log = _set_env({journal_mod.ENV_VAR: str(log_dir)})
+                journal.configure(log_dir, role="chaos-runner")
+                try:
+                    sc.fn(Path(td), check)
+                finally:
+                    _restore_env(saved_log)
+                flights = sorted(log_dir.glob("flight-*.json"))
+                if flights:
+                    try:
+                        flight = json.loads(flights[-1].read_text())
+                    except (OSError, json.JSONDecodeError):
+                        flight = None
+                if name in _JOURNALED_SCENARIOS:
+                    _journal_checks(check, log_dir, flights)
     except Exception:
         error = traceback.format_exc()
     finally:
         _restore_env(saved)
         uninstall()
+        if prev_journal_dir is not None:
+            journal.configure(prev_journal_dir, role=prev_journal_role)
+        else:
+            journal.close()
+    # lint: disable=RF007 — fed to chaos.scenario_s; body runs under a span
     duration = time.monotonic() - t0
     telemetry.observe("chaos.scenario_s", duration)
     if name in _RECOVERY_SCENARIOS:
@@ -112,7 +159,30 @@ def run_scenario(name: str) -> ScenarioReport:
     passed = error is None and bool(checks) and all(c.ok for c in checks)
     return ScenarioReport(name=name, passed=passed, checks=checks,
                           schedule=plane.schedule(), duration_s=duration,
-                          error=error)
+                          error=error, flight_record=flight)
+
+
+def _journal_checks(check, log_dir: Path, flights: List[Path]) -> None:
+    """The journals-alone reconstruction story for a kill scenario: the
+    merged journal files must show the injection, the death, and the
+    trial lifecycle — across at least the runner and one worker — and
+    the scheduler must have dumped a flight record for the dead child."""
+    recs = journal_mod.read_dir(log_dir)
+    pids = {r.get("pid") for r in recs}
+    check("journal_multi_process", len(pids) >= 2,
+          f"records from {len(pids)} pid(s)")
+    check("journal_records_kill_injection",
+          any(r.get("kind") == "chaos" and r.get("mode") == "kill"
+              for r in recs),
+          "no chaos/injected kill record in the journals")
+    ev = {r.get("name") for r in recs if r.get("kind") == "event"}
+    check("journal_records_trial_lifecycle",
+          {"trial_started", "trial_completed"} <= ev,
+          f"event names journaled: {sorted(ev)}")
+    check("journal_records_worker_death", "worker_died" in ev,
+          f"event names journaled: {sorted(ev)}")
+    check("flight_record_dumped", bool(flights),
+          f"no flight-*.json under {log_dir}")
 
 
 def run_scenarios(names: Optional[List[str]] = None) -> List[ScenarioReport]:

@@ -39,6 +39,8 @@ import threading
 from rafiki_tpu import chaos, telemetry
 from rafiki_tpu.gateway.admission import AdmissionController, ShedError
 from rafiki_tpu.gateway.breaker import CircuitBreaker
+from rafiki_tpu.obs import context as trace_context
+from rafiki_tpu.obs.journal import journal as _journal
 
 POLICIES = ("replicate-all", "least-loaded")
 
@@ -109,10 +111,21 @@ class Gateway:
     # -- the predict path ----------------------------------------------------
 
     def predict(self, queries: List[Any],
-                deadline_s: Optional[float] = None) -> List[Any]:
+                deadline_s: Optional[float] = None,
+                trace_id: Optional[str] = None) -> List[Any]:
         """Admit → route → quorum-gather → feed breakers. Raises
         :class:`ShedError` when admission refuses, RuntimeError when
-        the job has no live workers."""
+        the job has no live workers.
+
+        This is the trace edge: a request either carries a caller
+        trace id (``X-Rafiki-Trace-Id`` upstream) or gets a fresh one
+        here, and everything downstream — bus envelopes, worker spans,
+        journal records in every process — stitches to it."""
+        with trace_context.trace(trace_id):
+            return self._predict(queries, deadline_s)
+
+    def _predict(self, queries: List[Any],
+                 deadline_s: Optional[float]) -> List[Any]:
         deadline_s = (deadline_s or self.cfg.default_deadline_s
                       or self.predictor.timeout_s)
         deadline = time.monotonic() + deadline_s
@@ -144,14 +157,21 @@ class Gateway:
         chaos.hook("gateway.predict", self.predictor.job_id)
         t0 = time.monotonic()
         try:
-            workers, quorum = self._route()
-            report = self.predictor.predict_detailed(
-                queries, workers=workers,
-                timeout_s=max(0.0, deadline - time.monotonic()),
-                min_replies=quorum,
-                hedge_grace_s=self.cfg.hedge_grace_s)
+            # The gateway span is the trace root on the serving path:
+            # bus envelopes fanned out under it carry its span_id as
+            # parent_span, so the stitched trace hangs together.
+            with telemetry.span("gateway.predict",
+                                job_id=self.predictor.job_id,
+                                queries=len(queries)):
+                workers, quorum = self._route()
+                report = self.predictor.predict_detailed(
+                    queries, workers=workers,
+                    timeout_s=max(0.0, deadline - time.monotonic()),
+                    min_replies=quorum,
+                    hedge_grace_s=self.cfg.hedge_grace_s)
         finally:
             self.admission.release()
+        # lint: disable=RF007 — breaker EWMA input; region is under the span
         self._absorb(report, time.monotonic() - t0)
         return report.outputs
 
@@ -190,10 +210,18 @@ class Gateway:
         n_queries = len(report.outputs)
         for w in report.workers:
             br = self._breaker(w)
+            state_before = br.snapshot().get("state")
             if report.replies.get(w, 0) > 0:
                 br.record_success(latency_s=elapsed_s)
             else:
                 br.record_failure()
+            state_after = br.snapshot().get("state")
+            if state_after != state_before:
+                # Breaker decisions are journal-worthy: a post-mortem
+                # needs to see WHY fan-out avoided a worker.
+                _journal.record("gateway", "breaker_transition",
+                                worker_id=w, from_state=state_before,
+                                to_state=state_after)
         with self._lock:
             self._hedged += report.hedged
             self._timeouts += report.timeouts
@@ -223,6 +251,7 @@ class Gateway:
             self._shed[reason] = self._shed.get(reason, 0) + 1
         telemetry.inc("gateway.shed")
         telemetry.inc(f"gateway.shed_{reason}")
+        _journal.record("gateway", "shed", reason=reason)
 
     # -- drain ---------------------------------------------------------------
 

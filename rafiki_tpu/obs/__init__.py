@@ -1,0 +1,46 @@
+"""Sweep-wide observability plane (docs/observability.md).
+
+Four layers on top of :mod:`rafiki_tpu.telemetry`:
+
+* :mod:`~rafiki_tpu.obs.context` — trace ids propagated across threads,
+  bus envelopes and worker-spawn env;
+* :mod:`~rafiki_tpu.obs.journal` — bounded per-process JSONL journals
+  under ``RAFIKI_LOG_DIR`` that spans/events/chaos decisions flush into;
+* :mod:`~rafiki_tpu.obs.ledger` — goodput/cost accounting (compile vs
+  step vs feed vs checkpoint vs downtime) per trial/pack/job;
+* :mod:`~rafiki_tpu.obs.recorder` — flight recorder dumping the last-N
+  ring to disk on fatal/interrupt;
+
+plus :mod:`~rafiki_tpu.obs.prom` (Prometheus text exposition of the
+registry snapshot) and the ``python -m rafiki_tpu.obs`` CLI
+(:mod:`~rafiki_tpu.obs.cli`) that merges journals across processes.
+
+Import discipline: this package's eager surface (context, journal) is
+stdlib-only so telemetry can import it without a cycle; ledger/prom/
+recorder/cli import telemetry and load lazily via ``__getattr__``.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from rafiki_tpu.obs import context, journal  # noqa: F401  (eager, dep-free)
+
+_LAZY = ("ledger", "prom", "recorder", "cli")
+
+__all__ = ["context", "journal", *_LAZY, "configure_from_env"]
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        mod = importlib.import_module(f"rafiki_tpu.obs.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def configure_from_env(role=None) -> bool:
+    """One call a process makes at startup: adopt RAFIKI_TRACE_ID and,
+    when RAFIKI_LOG_DIR is set, open this process's journal. Returns
+    True when a journal was configured."""
+    return journal.configure_from_env(role=role)

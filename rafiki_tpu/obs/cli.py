@@ -1,0 +1,114 @@
+"""``python -m rafiki_tpu.obs`` — read the merged cross-process journals.
+
+Subcommands (all read ``journal-*.jsonl*`` under ``--dir``, default
+``$RAFIKI_LOG_DIR`` then the configured ``logs_dir``):
+
+    trace <id>     every record carrying the trace id (prefix match),
+                   time-ordered across processes, one line per hop —
+                   the stitched end-to-end view of one query or trial
+    tail [-n N]    the last N records fleet-wide
+    slowest [-n N] the N slowest finished spans
+
+Output is one human line per record by default, ``--json`` for JSONL
+(pipe into jq). Exit code 1 when a requested trace has no records.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from rafiki_tpu.obs import journal as journal_mod
+
+
+def _default_dir() -> str:
+    d = os.environ.get(journal_mod.ENV_VAR)
+    if d:
+        return d
+    from rafiki_tpu.config import get_config
+    return str(get_config().logs_dir)
+
+
+def _fmt_record(rec: Dict[str, Any], t0: float) -> str:
+    dt = rec.get("ts", 0.0) - t0
+    who = f"{rec.get('role', '?')}/{rec.get('pid', '?')}"
+    head = f"+{dt:9.3f}s  {who:<18} {rec.get('kind', '?'):<7} {rec.get('name', '?')}"
+    parts = []
+    if rec.get("dur_s") is not None:
+        parts.append(f"dur={rec['dur_s']:.4f}s")
+    for k in ("trial_id", "worker_id", "query_id", "site", "mode", "event",
+              "reason", "path", "error"):
+        if rec.get(k) is not None:
+            parts.append(f"{k}={rec[k]}")
+    tags = rec.get("tags")
+    if isinstance(tags, dict):
+        parts.extend(f"{k}={v}" for k, v in tags.items())
+    return head + ("  [" + " ".join(parts) + "]" if parts else "")
+
+
+def _emit(records: List[Dict[str, Any]], as_json: bool) -> None:
+    if as_json:
+        for rec in records:
+            print(json.dumps(rec, default=str))
+        return
+    t0 = records[0].get("ts", 0.0) if records else 0.0
+    for rec in records:
+        print(_fmt_record(rec, t0))
+
+
+def cmd_trace(log_dir: str, trace_id: str, as_json: bool) -> int:
+    records = [r for r in journal_mod.read_dir(log_dir)
+               if str(r.get("trace_id", "")).startswith(trace_id)]
+    if not records:
+        print(f"no journal records for trace {trace_id!r} under {log_dir}",
+              file=sys.stderr)
+        return 1
+    _emit(records, as_json)
+    if not as_json:
+        pids = {(r.get("role"), r.get("pid")) for r in records}
+        wall = records[-1].get("ts", 0.0) - records[0].get("ts", 0.0)
+        print(f"-- trace {records[0].get('trace_id')}: {len(records)} records "
+              f"across {len(pids)} processes, {wall:.3f}s")
+    return 0
+
+
+def cmd_tail(log_dir: str, n: int, as_json: bool) -> int:
+    _emit(journal_mod.read_dir(log_dir)[-n:], as_json)
+    return 0
+
+
+def cmd_slowest(log_dir: str, n: int, as_json: bool) -> int:
+    spans = [r for r in journal_mod.read_dir(log_dir)
+             if r.get("kind") == "span" and r.get("dur_s") is not None]
+    spans.sort(key=lambda r: r["dur_s"], reverse=True)
+    _emit(spans[:n], as_json)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m rafiki_tpu.obs",
+        description="merge and query the per-process observability journals")
+    p.add_argument("--dir", default=None,
+                   help="journal directory (default: $RAFIKI_LOG_DIR, "
+                        "then the configured logs_dir)")
+    p.add_argument("--json", action="store_true",
+                   help="emit raw JSONL instead of formatted lines")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sp = sub.add_parser("trace", help="stitch one trace across processes")
+    sp.add_argument("trace_id")
+    sp = sub.add_parser("tail", help="last N records fleet-wide")
+    sp.add_argument("-n", type=int, default=32)
+    sp = sub.add_parser("slowest", help="N slowest spans")
+    sp.add_argument("-n", type=int, default=16)
+    args = p.parse_args(argv)
+
+    log_dir = args.dir or _default_dir()
+    if args.cmd == "trace":
+        return cmd_trace(log_dir, args.trace_id, args.json)
+    if args.cmd == "tail":
+        return cmd_tail(log_dir, args.n, args.json)
+    return cmd_slowest(log_dir, args.n, args.json)

@@ -542,6 +542,7 @@ class TrainLoop:
             batch.pop("valid", None)
             t_feed = time.monotonic()
             dev = self.plan.put_batch(batch)
+            # lint: disable=RF007 — feed_s accumulator for the ledger split
             feed_s += time.monotonic() - t_feed
             return dev
 
@@ -561,14 +562,23 @@ class TrainLoop:
         """Compile-vs-step-vs-feed attribution at epoch granularity: the
         first epoch of a TrainLoop pays the XLA compile (or the program-
         cache hit), so its wall-clock lands in a separate histogram
-        instead of polluting the steady-state distribution."""
+        instead of polluting the steady-state distribution.
+
+        The same split feeds the goodput ledger (docs/observability.md):
+        a cold epoch's non-feed wall is billed as compile (it contains
+        the program build), warm epochs as productive step time."""
+        from rafiki_tpu.obs.ledger import ledger
+
+        # lint: disable=RF007 — epoch wall split into ledger buckets
         dt = time.monotonic() - t0
         cold = not getattr(self, "_warm", False)
         self._warm = True
         telemetry.observe("train.cold_epoch_s" if cold else "train.epoch_s", dt)
         if feed_s > 0.0:
             telemetry.inc("train.host_feed_s", feed_s)
+            ledger.add("feed_s", feed_s)
         telemetry.inc("train.step_s", max(dt - feed_s, 0.0))
+        ledger.add("compile_s" if cold else "step_s", max(dt - feed_s, 0.0))
 
     def evaluate(self, dataset, batch_size: int) -> float:
         total_correct = jnp.zeros((), jnp.int32)
@@ -813,11 +823,17 @@ class PackedTrainLoop:
                 for i in range(self.k)]
 
     def _record_epoch(self, t0: float) -> None:
+        from rafiki_tpu.obs.ledger import ledger
+
+        # lint: disable=RF007 — epoch wall split into ledger buckets
         dt = time.monotonic() - t0
         cold = not getattr(self, "_warm", False)
         self._warm = True
         telemetry.observe("train.packed_cold_epoch_s" if cold
                           else "train.packed_epoch_s", dt)
+        # Goodput ledger: same convention as the serial loop — the cold
+        # (compile-paying) epoch is overhead, warm epochs are productive.
+        ledger.add("compile_s" if cold else "step_s", dt)
 
     def evaluate(self, dataset, batch_size: int) -> np.ndarray:
         """(k,) per-trial accuracies over one shared eval pass: the

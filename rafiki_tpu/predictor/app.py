@@ -57,7 +57,14 @@ class PredictorApp:
             elif request.path == "/metrics" and request.method == "GET":
                 from rafiki_tpu import telemetry
 
-                response = self._json(telemetry.snapshot())
+                if request.args.get("format") == "prom":
+                    from rafiki_tpu.obs import prom
+
+                    response = Response(
+                        prom.to_prometheus(telemetry.snapshot()),
+                        mimetype="text/plain; version=0.0.4")
+                else:
+                    response = self._json(telemetry.snapshot())
             elif request.path == "/gateway" and request.method == "GET":
                 response = self._json(self.gateway.stats())
             elif request.path == "/drain" and request.method == "POST":
@@ -103,8 +110,18 @@ class PredictorApp:
                                   400)
             if deadline_s <= 0:
                 return self._json({"error": "deadline_s must be > 0"}, 400)
-        preds = self.gateway.predict(queries, deadline_s=deadline_s)
-        return self._json({"predictions": _jsonable(preds)})
+        # Trace propagation in: a client (or upstream proxy) may pin
+        # the trace id; otherwise the gateway mints one. Either way the
+        # id is echoed back so callers can `obs trace <id>` the request.
+        trace_id = request.headers.get("X-Rafiki-Trace-Id")
+        from rafiki_tpu.obs import context as trace_context
+
+        with trace_context.trace(trace_id) as tid:
+            preds = self.gateway.predict(queries, deadline_s=deadline_s)
+        response = self._json({"predictions": _jsonable(preds),
+                               "trace_id": tid})
+        response.headers["X-Rafiki-Trace-Id"] = tid
+        return response
 
     @staticmethod
     def _json(data: Any, status: int = 200) -> Response:

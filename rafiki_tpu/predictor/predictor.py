@@ -33,6 +33,8 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from rafiki_tpu import telemetry
+from rafiki_tpu.obs import context as trace_context
+from rafiki_tpu.obs.journal import journal as _journal
 from rafiki_tpu.predictor.ensemble import ensemble_predictions
 
 
@@ -117,7 +119,19 @@ class Predictor:
         """The full-control entry the gateway uses: an explicit fan-out
         set (already breaker-filtered), a per-request gather budget,
         and a reply quorum. Returns per-worker reply counts alongside
-        the ensembled outputs."""
+        the ensembled outputs.
+
+        Trace edge: the batch binds a trace context (inheriting the
+        gateway's when called from one, minting a fresh id when used
+        standalone) so every bus envelope, worker span and journal
+        record of this batch stitches into one end-to-end trace."""
+        with trace_context.trace():
+            return self._predict_detailed(
+                queries, workers=workers, timeout_s=timeout_s,
+                min_replies=min_replies, hedge_grace_s=hedge_grace_s)
+
+    def _predict_detailed(self, queries, workers=None, timeout_s=None,
+                          min_replies=None, hedge_grace_s=None) -> GatherReport:
         if workers is None:
             workers = self.live_workers()
         if not workers:
@@ -161,6 +175,7 @@ class Predictor:
                 qid, n=len(workers), timeout=remaining,
                 min_n=quorum, grace_s=grace)
             telemetry.observe("predictor.gather_quorum_s",
+                              # lint: disable=RF007 — the delta IS the observation
                               time.monotonic() - t_q)
             for w, _ in preds:
                 replies[w] = replies.get(w, 0) + 1
@@ -171,12 +186,19 @@ class Predictor:
                 if len(preds) < len(workers):
                     hedged += 1
                 out.append(ensemble_predictions([p for _, p in preds]))
+        # lint: disable=RF007 — observed into gather_s right below
         elapsed = time.monotonic() - t_gather
         telemetry.observe("predictor.gather_s", elapsed)
         if timeouts:
             telemetry.inc("predictor.query_timeouts", timeouts)
         if hedged:
             telemetry.inc("predictor.hedged_gathers", hedged)
+        # Quorum decision record: which workers answered, who straggled
+        # (docs/observability.md — breaker/quorum decisions journal).
+        _journal.record("gather", "predictor.gather", job_id=self.job_id,
+                        queries=len(queries), workers=list(workers),
+                        quorum=quorum, replies=replies, timeouts=timeouts,
+                        hedged=hedged, dur_s=round(elapsed, 6))
         return GatherReport(outputs=out, workers=list(workers),
                             quorum=quorum, replies=replies,
                             timeouts=timeouts, hedged=hedged,

@@ -22,6 +22,7 @@ is shared over loopback HTTP (reference: advisor container + REST).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import secrets as _secrets
 import subprocess
@@ -31,6 +32,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 from rafiki_tpu import chaos
+from rafiki_tpu.obs import context as trace_context
+from rafiki_tpu.obs.journal import journal as _journal
 from rafiki_tpu.advisor import AdvisorService
 from rafiki_tpu.advisor.app import AdvisorApp
 from rafiki_tpu.constants import ServiceStatus, ServiceType, TrainJobStatus, TrialStatus
@@ -228,6 +231,12 @@ class ProcessScheduler:
         job = self.store.get_train_job(job_id)
         if job is None:
             raise KeyError(f"No train job {job_id!r}")
+        # Job-level trace: scheduler-side records (spawns, deaths,
+        # restarts) stitch under one id; each trial still mints its own
+        # trace (worker/train.py) and links back via trial_id fields.
+        _trace_scope = contextlib.ExitStack()
+        _trace_scope.enter_context(
+            trace_context.trace(trace_context.new_trace_id()))
         self.store.update_train_job_status(job_id, TrainJobStatus.RUNNING.value)
         events.emit("train_job_started", job_id=job_id, app=job["app"],
                     budget=job["budget"], scheduler="process")
@@ -272,6 +281,7 @@ class ProcessScheduler:
         finally:
             server.shutdown()
             thread.join(timeout=5)
+            _trace_scope.close()
 
         subs_after = self.store.get_sub_train_jobs(job_id)
         if stop_event.is_set():
@@ -347,6 +357,14 @@ class ProcessScheduler:
                 })
             if events.path is not None:  # subprocess shares the event sink
                 env["RAFIKI_EVENTS_DIR"] = str(events.path.parent)
+            # Observability propagation: the child journals into the
+            # same log dir and adopts this job's trace as its process
+            # default — the spawn edge of cross-process stitching.
+            if _journal.configured:
+                env["RAFIKI_LOG_DIR"] = str(_journal.log_dir)
+            _tid = trace_context.current_trace_id()
+            if _tid:
+                env["RAFIKI_TRACE_ID"] = _tid
             # Worker output goes to a temp file, not a pipe: a full
             # pipe buffer would block the worker's writes and
             # deadlock the supervise loop.
@@ -500,10 +518,28 @@ class ProcessScheduler:
                 g.partial_exit_at = None
                 self.store.update_service(
                     g.service["id"], status=ServiceStatus.ERRORED.value)
+                # Flight record on the dead child's behalf: a SIGKILLed
+                # worker gets no in-process hook, so the scheduler — the
+                # only survivor that saw the death — dumps what it knows.
+                from rafiki_tpu.obs import recorder
+
+                recorder.dump(
+                    f"worker_died:{g.leader_worker_id}",
+                    extra={"worker_index": g.index,
+                           "service_id": g.service["id"],
+                           "restarts": g.restarts,
+                           "detail": (failures[0][:500] if failures else "")})
                 if g.restarts < max_restarts:
                     g.restarts += 1
                     g.dead_services.append(g.service["id"])
-                    g.respawn_at = now + backoff0 * (2 ** (g.restarts - 1))
+                    backoff_s = backoff0 * (2 ** (g.restarts - 1))
+                    g.respawn_at = now + backoff_s
+                    # The death→respawn gap is capacity the job paid for
+                    # and didn't use: charge it to the goodput ledger.
+                    from rafiki_tpu.obs.ledger import ledger
+
+                    ledger.add("downtime_s", backoff_s,
+                               entity=f"job:{job['id']}")
                     events.emit("worker_died", job_id=job["id"],
                                 worker_index=g.index,
                                 restart_attempt=g.restarts,

@@ -38,7 +38,7 @@ __all__ = [
     "inc", "set_gauge", "add_gauge", "observe", "span",
     "get_counter", "get_gauge", "get_registry", "get_tracer",
     "register_collector", "snapshot", "span_records", "dump_jsonl",
-    "reset",
+    "reset", "current_span_id",
 ]
 
 _registry = Registry()
@@ -74,6 +74,11 @@ def observe(name: str, value: float) -> None:
 
 def span(name: str, **tags: Any) -> Span:
     return _tracer.span(name, **tags)
+
+
+def current_span_id():
+    """The innermost open span id on this thread, or None."""
+    return _tracer.current_span_id()
 
 
 def register_collector(name: str, fn: Callable[[], Dict[str, Any]]) -> None:

@@ -21,14 +21,19 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional
+
+from rafiki_tpu.obs import context as _trace_context
+from rafiki_tpu.obs.journal import journal as _journal
 
 
 class Span:
     """Context manager recording one timed, possibly-nested phase."""
 
-    __slots__ = ("name", "tags", "_tracer", "_t0", "_start_ts", "_parent")
+    __slots__ = ("name", "tags", "_tracer", "_t0", "_start_ts",
+                 "_parent", "_span_id", "_parent_id", "_trace_id")
 
     def __init__(self, tracer: "Tracer", name: str, tags: Dict[str, Any]):
         self._tracer = tracer
@@ -37,11 +42,21 @@ class Span:
         self._t0 = 0.0
         self._start_ts = 0.0
         self._parent: Optional[str] = None
+        self._span_id = ""
+        self._parent_id: Optional[str] = None
+        self._trace_id: Optional[str] = None
+
+    @property
+    def span_id(self) -> str:
+        return self._span_id
 
     def __enter__(self) -> "Span":
         stack = self._tracer._stack()
-        self._parent = stack[-1] if stack else None
-        stack.append(self.name)
+        if stack:
+            self._parent, self._parent_id = stack[-1]
+        self._span_id = uuid.uuid4().hex[:16]
+        self._trace_id = _trace_context.current_trace_id()
+        stack.append((self.name, self._span_id))
         self._start_ts = time.time()
         self._t0 = time.monotonic()
         return self
@@ -49,7 +64,7 @@ class Span:
     def __exit__(self, exc_type, exc, tb) -> bool:
         dur = time.monotonic() - self._t0
         stack = self._tracer._stack()
-        if stack and stack[-1] == self.name:
+        if stack and stack[-1][0] == self.name:
             stack.pop()
         self._tracer._record(self, dur, error=exc_type is not None)
         return False  # never swallow
@@ -65,11 +80,18 @@ class Tracer:
         self._agg: Dict[str, List[float]] = {}
         self._records: "deque[Dict[str, Any]]" = deque(maxlen=self._RECORD_CAP)
 
-    def _stack(self) -> List[str]:
+    def _stack(self) -> list:
+        """Per-thread stack of (name, span_id) tuples for open spans."""
         stack = getattr(self._tls, "stack", None)
         if stack is None:
             stack = self._tls.stack = []
         return stack
+
+    def current_span_id(self) -> Optional[str]:
+        """The innermost open span's id on this thread (trace
+        propagation: the bus envelope carries it as parent_span)."""
+        stack = self._stack()
+        return stack[-1][1] if stack else None
 
     def span(self, name: str, **tags: Any) -> Span:
         return Span(self, name, tags)
@@ -81,11 +103,23 @@ class Tracer:
             "ts": span._start_ts,
             "dur_s": round(dur_s, 6),
             "parent": span._parent,
+            "span_id": span._span_id,
+            "parent_id": span._parent_id,
         }
+        if span._trace_id:
+            rec["trace_id"] = span._trace_id
         if span.tags:
             rec["tags"] = span.tags
         if error:
             rec["error"] = True
+        # Durable copy first (journal has its own lock; no-op when the
+        # process hasn't opted in via RAFIKI_LOG_DIR).
+        _journal.record(
+            "span", span.name, ts=span._start_ts,
+            dur_s=rec["dur_s"], span_id=span._span_id,
+            parent_id=span._parent_id, trace_id=span._trace_id,
+            **({"tags": span.tags} if span.tags else {}),
+            **({"error": True} if error else {}))
         with self._lock:
             agg = self._agg.get(span.name)
             if agg is None:

@@ -30,6 +30,8 @@ import time
 from pathlib import Path
 from typing import Any, Iterator, Optional
 
+from rafiki_tpu.obs.journal import journal as _journal
+
 
 class EventLog:
     def __init__(self, logs_dir: Optional[str | os.PathLike] = None,
@@ -56,6 +58,10 @@ class EventLog:
         return self._path
 
     def emit(self, event: str, **fields: Any) -> None:
+        # Mirror into the per-process journal (no-op unless the process
+        # opted in via RAFIKI_LOG_DIR) so trial lifecycle / checkpoint
+        # events land in the same stream spans do (docs/observability.md).
+        _journal.record("event", event, **fields)
         with self._lock:
             if self._fh is None:
                 return

@@ -12,6 +12,7 @@ pass — the device sees large batches, not query-at-a-time traffic.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, List, Optional
 
@@ -19,6 +20,8 @@ import numpy as np
 
 from rafiki_tpu import chaos, telemetry
 from rafiki_tpu.model.base import BaseModel
+from rafiki_tpu.obs import context as trace_context
+from rafiki_tpu.obs.journal import journal as _journal
 
 
 class InferenceWorker:
@@ -59,16 +62,32 @@ class InferenceWorker:
                                              timeout=0.1)
                 if not items:
                     continue
-                qids = [qid for qid, _ in items]
-                queries = [q for _, q in items]
+                # Envelopes are (qid, query) or traced (qid, query, trace)
+                # — see bus/queues.py. A micro-batch can mix traces; the
+                # forward span binds to the first one, and every traced
+                # query gets its own journal hop so each trace stitches.
+                qids = [item[0] for item in items]
+                queries = [item[1] for item in items]
+                traces = [item[2] if len(item) > 2 else None
+                          for item in items]
+                lead = next((t for t in traces if t), None)
+                for qid, tr in zip(qids, traces):
+                    if tr:
+                        _journal.record(
+                            "bus", "pop_query", query_id=qid,
+                            worker_id=self.worker_id,
+                            trace_id=tr.get("trace_id"),
+                            parent_span=tr.get("parent_span"))
+                bind = (trace_context.trace(lead.get("trace_id")) if lead
+                        else contextlib.nullcontext())
                 try:
                     # Chaos: a delay here is a latency spike / stuck
                     # replica (the lease stays fresh — the beat thread
                     # runs on); an error is a poisoned forward. Both
                     # exercise the gateway's quorum + breaker paths.
                     chaos.hook("inference.forward", self.worker_id)
-                    with telemetry.span("inference.forward",
-                                        worker_id=self.worker_id):
+                    with bind, telemetry.span("inference.forward",
+                                              worker_id=self.worker_id):
                         preds = self._predict(queries)
                     telemetry.inc("inference.queries_served", len(queries))
                 except Exception as e:  # a bad query batch must not kill the worker
@@ -104,6 +123,14 @@ def run_inference_worker_process(bus, meta_path: str, params_path: str,
     from rafiki_tpu.utils.backend import honor_env_platform
 
     honor_env_platform()
+
+    # Observability plane: journal under RAFIKI_LOG_DIR (inherited via
+    # the spawn env), adopt RAFIKI_TRACE_ID, dump a flight record on
+    # fatal/SIGTERM (docs/observability.md).
+    from rafiki_tpu import obs
+
+    if obs.configure_from_env(role="infer"):
+        obs.recorder.install()
 
     from rafiki_tpu.model.base import load_model_class
     from rafiki_tpu.store import MetaStore, ParamsStore

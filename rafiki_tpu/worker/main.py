@@ -93,6 +93,15 @@ def main() -> int:
 
     configure_from_env()
 
+    # Observability plane: per-process journal under RAFIKI_LOG_DIR
+    # (spawn env), adopt the scheduler's RAFIKI_TRACE_ID as the process
+    # default, dump a flight record on fatal/SIGTERM so a killed worker
+    # leaves a reconstructible last-N trail (docs/observability.md).
+    from rafiki_tpu import obs
+
+    if obs.configure_from_env(role="train-worker"):
+        obs.recorder.install()
+
     from rafiki_tpu.store import MetaStore, ParamsStore
 
     store = MetaStore(db_path)

@@ -30,6 +30,8 @@ from rafiki_tpu.constants import BudgetType, TrainJobStatus, TrialStatus
 from rafiki_tpu.model.base import BaseModel, load_model_class
 from rafiki_tpu.model.knobs import Knobs, knob_config_signature
 from rafiki_tpu.model.log import logger
+from rafiki_tpu.obs import context as trace_context
+from rafiki_tpu.obs.ledger import ledger
 from rafiki_tpu.store import MetaStore, ParamsStore
 from rafiki_tpu.utils.events import events
 
@@ -167,6 +169,15 @@ class TrainWorker:
                     self._last_heartbeat = now
                     self.store.update_service(self.service_id, heartbeat=True)
 
+        import contextlib
+
+        # One trial = one trace: spans, journal records and the goodput
+        # ledger entity all stitch under it across processes
+        # (docs/observability.md). A resumed trial mints a fresh trace —
+        # the journal links the attempts through the trial_id field.
+        _trace_scope = contextlib.ExitStack()
+        _trace_scope.enter_context(
+            trace_context.trace(trace_context.new_trace_id()))
         events.emit("trial_started", trial_id=tid, sub_job_id=self.sub_id,
                     model=self.model_class.__name__, worker_id=self.worker_id,
                     knobs=knobs)
@@ -175,6 +186,7 @@ class TrainWorker:
         try:
             with telemetry.span("trial.total", trial_id=tid,
                                 worker_id=self.worker_id), \
+                    ledger.entity(f"trial:{tid}"), \
                     logger.capture(sink), self._device_scope(), \
                     self._profile_scope(tid):
                 with telemetry.span("trial.build", trial_id=tid):
@@ -218,6 +230,7 @@ class TrainWorker:
                 pass
             return self.store.get_trial(tid)
         finally:
+            _trace_scope.close()
             if model is not None and not persisted_async:
                 model.destroy()
 
@@ -271,13 +284,20 @@ class TrainWorker:
         ``store.params_write`` fault) must cost resumability, not the
         trial — the training loop has the real result in device memory
         and must keep going."""
+        t0 = time.monotonic()
         try:
             self.params_store.save_checkpoint(tid, epoch, make_blob())
+            events.emit("checkpoint_written", trial_id=tid, epoch=epoch,
+                        worker_id=self.worker_id)
         except Exception:
             telemetry.inc("worker.checkpoint_write_failed")
             events.emit("checkpoint_write_failed", trial_id=tid, epoch=epoch,
                         worker_id=self.worker_id,
                         error=traceback.format_exc(limit=3))
+        finally:
+            # lint: disable=RF007 — checkpoint_s ledger charge, not a span
+            ledger.add("checkpoint_s", time.monotonic() - t0,
+                       entity=f"trial:{tid}")
 
     def resume_trial(self, trial_id: str) -> dict:
         """Re-run an interrupted trial, continuing from its newest
@@ -298,12 +318,18 @@ class TrainWorker:
     def _persist(self, tid: str, model: BaseModel, score: float) -> None:
         """Dump → write → mark completed (runs on the saver thread when
         async persistence is on)."""
+        t0 = time.monotonic()
         try:
             with telemetry.span("trial.persist", trial_id=tid):
                 blob = model.dump_parameters()
                 params_id = self.params_store.save(blob)
                 self.store.mark_trial_as_completed(tid, score, params_id)
                 self.params_store.delete_checkpoints(tid)  # superseded
+            # Persist runs on the saver thread (no bound entity there),
+            # so the charge names its trial explicitly.
+            # lint: disable=RF007 — checkpoint_s ledger charge, not a span
+            ledger.add("checkpoint_s", time.monotonic() - t0,
+                       entity=f"trial:{tid}")
             events.emit("trial_completed", trial_id=tid, score=score,
                         worker_id=self.worker_id)
         except Exception:
@@ -520,9 +546,15 @@ class PackedTrialRunner:
                         model=w.model_class.__name__, worker_id=w.worker_id,
                         knobs=kn)
         models: List[BaseModel] = []
+        pack_entity = f"pack:{w.worker_id}:k{k}"
         try:
-            with telemetry.span("trial_pack.total", worker_id=w.worker_id,
-                                k=k), w._device_scope():
+            # One pack = one trace + one ledger entity: the pack's
+            # compile/step/feed/checkpoint split is shared cost across
+            # its k trials (docs/observability.md).
+            with trace_context.trace(trace_context.new_trace_id()), \
+                    telemetry.span("trial_pack.total", worker_id=w.worker_id,
+                                   k=k), \
+                    ledger.entity(pack_entity), w._device_scope():
                 with telemetry.span("trial_pack.build"):
                     models = [w.model_class(**kn) for _, kn in rows]
 
@@ -607,6 +639,7 @@ class PackedTrialRunner:
         resumability, never the pack — training has the real state in
         device memory and must keep going."""
         w = self.w
+        t0 = time.monotonic()
         try:
             blobs = make_blobs()
         except Exception:
@@ -614,15 +647,22 @@ class PackedTrialRunner:
             events.emit("checkpoint_write_failed", epoch=epoch,
                         worker_id=w.worker_id, trial_id=rows[0][0],
                         error=traceback.format_exc(limit=3))
+            # lint: disable=RF007 — checkpoint_s ledger charge, not a span
+            ledger.add("checkpoint_s", time.monotonic() - t0)
             return
         for (tid, _kn), blob in zip(rows, blobs):
             try:
                 w.params_store.save_checkpoint(tid, epoch, blob)
+                events.emit("checkpoint_written", trial_id=tid, epoch=epoch,
+                            worker_id=w.worker_id)
             except Exception:
                 telemetry.inc("worker.checkpoint_write_failed")
                 events.emit("checkpoint_write_failed", trial_id=tid,
                             epoch=epoch, worker_id=w.worker_id,
                             error=traceback.format_exc(limit=3))
+        # Charged to the bound pack entity (the sink runs inside it).
+        # lint: disable=RF007 — checkpoint_s ledger charge, not a span
+        ledger.add("checkpoint_s", time.monotonic() - t0)
 
 
 class _AsyncSaver:

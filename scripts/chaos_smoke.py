@@ -44,18 +44,28 @@ def main() -> int:
 
     t0 = time.monotonic()
     reports = run_scenarios(SCENARIOS)
+    # The kill scenario must leave black-box evidence: the scheduler
+    # dumps a flight record on the SIGKILLed worker's behalf, and the
+    # runner carries it in the report (docs/observability.md). A kill
+    # we can't reconstruct afterwards fails the gate even if recovery
+    # itself worked.
+    kill = next(r for r in reports if r.name == "kill-mid-trial-resume")
+    flight_missing = kill.flight_record is None
     out = {
         "scenarios": len(reports),
         "passed": sum(1 for r in reports if r.passed),
         "injected_faults": sum(len(r.schedule) for r in reports),
+        # lint: disable=RF007 — smoke artifact wall-clock
         "wall_s": round(time.monotonic() - t0, 2),
         "reports": [r.to_dict() for r in reports],
     }
+    if flight_missing:
+        out["problems"] = ["kill-mid-trial-resume produced no flight record"]
     print(json.dumps(out, indent=2))
     failed = [r for r in reports if not r.passed]
     for r in failed:
         print(format_report(r), file=sys.stderr)
-    return 1 if failed else 0
+    return 1 if failed or flight_missing else 0
 
 
 if __name__ == "__main__":

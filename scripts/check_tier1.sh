@@ -27,6 +27,11 @@ env JAX_PLATFORMS=cpu RAFIKI_TRIAL_PACK=4 python scripts/smoke_trial_pack.py > /
 # under load. ~10s; fails the gate on any violated recovery invariant.
 env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py > /tmp/_chaos_smoke.json \
   || { echo "TIER1 CHAOS SMOKE FAILED (see /tmp/_chaos_smoke.json)"; exit 1; }
+# Observability smoke: one gateway query traced end to end — the
+# `obs trace` CLI must stitch >= 3 processes from the journals, and
+# /metrics?format=prom must line-parse (docs/observability.md). ~6s.
+env JAX_PLATFORMS=cpu python scripts/obs_smoke.py > /tmp/_obs_smoke.json \
+  || { echo "TIER1 OBS SMOKE FAILED (see /tmp/_obs_smoke.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Observability CI smoke: one traced query stitched across processes.
+
+End-to-end check of the observability plane (docs/observability.md):
+
+  1. train one tiny trial, then serve it from TWO real inference worker
+     processes over the mp bus — journals land under a shared
+     ``RAFIKI_LOG_DIR`` (one JSONL file per process);
+  2. POST one query through the gateway WSGI app with a pinned
+     ``X-Rafiki-Trace-Id``, then run the REAL reader —
+     ``python -m rafiki_tpu.obs trace <id>`` — and assert the stitched
+     trace spans >= 3 distinct processes (gateway + both workers);
+  3. GET ``/metrics?format=prom`` and line-parse the exposition: every
+     line must be a comment or a ``name[{labels}] value`` sample.
+
+Output: one JSON object on stdout, e.g.
+
+  {"trace_id": ..., "trace_records": 9, "trace_processes": 3,
+   "prom_lines": 120, "wall_s": ...}
+
+Exit code: 0 when every assertion holds; 1 otherwise — this is a CI
+gate (scripts/check_tier1.sh), not just a number printer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODEL_SRC = b"""
+from rafiki_tpu.model.base import JaxModel
+from rafiki_tpu.model.knobs import FixedKnob, FloatKnob
+from rafiki_tpu.models.ff import _Mlp
+
+class ObsFF(JaxModel):
+    @staticmethod
+    def get_knob_config():
+        return {
+            "learning_rate": FloatKnob(1e-4, 1e-1, is_exp=True),
+            "batch_size": FixedKnob(64),
+            "epochs": FixedKnob(2),
+            "seed": FixedKnob(0),
+        }
+
+    def build_module(self, num_classes, input_shape):
+        return _Mlp(hidden_layers=1, hidden_units=32, num_classes=num_classes)
+"""
+
+TRAIN = "synthetic://images?classes=4&n=256&w=8&h=8&c=1&seed=0"
+VAL = "synthetic://images?classes=4&n=64&w=8&h=8&c=1&seed=1"
+JOB = "obs-smoke"
+N_WORKERS = 2
+
+# Prometheus text exposition: comments, or `name[{labels}] value`.
+_PROM_COMMENT = re.compile(r"^# (TYPE|HELP) ")
+_PROM_SAMPLE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.e+-]+(\s+[0-9]+)?$')
+
+
+def _spawn_workers(ctx, bus, tmp, trial_id):
+    import multiprocessing  # noqa: F401  (spawn ctx passed in)
+
+    from rafiki_tpu.worker.inference import run_inference_worker_process
+
+    procs = [
+        ctx.Process(
+            target=run_inference_worker_process,
+            args=(bus, os.path.join(tmp, "meta.sqlite3"),
+                  os.path.join(tmp, "params"), trial_id, JOB, f"ow-{i}"),
+            daemon=True)
+        for i in range(N_WORKERS)
+    ]
+    for p in procs:
+        p.start()
+    deadline = time.monotonic() + 120
+    while len(bus.get_workers(JOB)) < len(procs):
+        dead = [(p.name, p.exitcode) for p in procs if not p.is_alive()]
+        if dead:
+            raise RuntimeError(f"worker died before registering: {dead}")
+        if time.monotonic() > deadline:
+            raise RuntimeError("inference workers never registered")
+        time.sleep(0.05)
+    return procs
+
+
+def _stitch_via_cli(log_dir: str, trace_id: str):
+    """Run the real reader — the exact command docs/observability.md
+    tells an operator to run — and parse its JSONL output."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.obs", "--dir", log_dir,
+         "--json", "trace", trace_id],
+        capture_output=True, text=True, timeout=60)
+    if proc.returncode != 0:
+        raise RuntimeError(f"obs trace exited {proc.returncode}: "
+                           f"{proc.stderr.strip()[:300]}")
+    records = [json.loads(line) for line in proc.stdout.splitlines() if line]
+    return records
+
+
+def main() -> int:
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()
+
+    import multiprocessing as mp
+
+    import numpy as np
+    from werkzeug.test import Client
+
+    from rafiki_tpu.bus import make_mp_bus
+    from rafiki_tpu.gateway import Gateway, GatewayConfig
+    from rafiki_tpu.model.base import load_model_class  # noqa: F401 (validates src)
+    from rafiki_tpu.obs.journal import journal
+    from rafiki_tpu.predictor import Predictor
+    from rafiki_tpu.predictor.app import PredictorApp
+    from rafiki_tpu.scheduler import LocalScheduler
+    from rafiki_tpu.store import MetaStore, ParamsStore
+
+    t0 = time.monotonic()
+    problems = []
+    with tempfile.TemporaryDirectory(prefix="rafiki-obssmoke-") as tmp:
+        log_dir = os.path.join(tmp, "obs")
+        # The spawn env is the propagation channel: children inherit
+        # RAFIKI_LOG_DIR and open their own journal files under it.
+        os.environ["RAFIKI_LOG_DIR"] = log_dir
+        journal.configure(log_dir, role="gateway")
+
+        store = MetaStore(os.path.join(tmp, "meta.sqlite3"))
+        params = ParamsStore(os.path.join(tmp, "params"))
+        model = store.create_model("obsff", "IMAGE_CLASSIFICATION", None,
+                                   MODEL_SRC, "ObsFF")
+        job = store.create_train_job("obs", "IMAGE_CLASSIFICATION", None,
+                                     TRAIN, VAL, {"MODEL_TRIAL_COUNT": 1})
+        store.create_sub_train_job(job["id"], model["id"])
+        result = LocalScheduler(store, params).run_train_job(
+            job["id"], n_workers=1, advisor_kind="random")
+        best = result.best_trials[0]
+
+        ctx = mp.get_context("spawn")
+        bus = make_mp_bus(ctx.Manager())
+        procs = _spawn_workers(ctx, bus, tmp, best["id"])
+        try:
+            predictor = Predictor(bus, JOB, timeout_s=10.0, worker_ttl_s=3.0)
+            gateway = Gateway(predictor, GatewayConfig(min_replies=2))
+            wsgi = Client(PredictorApp(gateway))
+            query = np.random.default_rng(0).uniform(
+                0, 1, size=(1, 8, 8, 1)).astype(np.float32)
+            payload = {"queries": [q.tolist() for q in query]}
+
+            # Warm until both subprocess compiles are paid and a batch
+            # answers cleanly within the deadline.
+            deadline = time.monotonic() + 120
+            while True:
+                r = wsgi.post("/predict", json=payload)
+                body = r.get_json() or {}
+                preds = body.get("predictions") or []
+                if r.status_code == 200 and preds and all(
+                        not (isinstance(p, dict) and "error" in p)
+                        for p in preds):
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"serving never warmed: {r.status_code} "
+                        f"{str(body)[:200]}")
+                time.sleep(0.5)
+
+            # THE traced query: pin the id, like a caller would.
+            tid = uuid.uuid4().hex
+            r = wsgi.post("/predict", json=payload,
+                          headers={"X-Rafiki-Trace-Id": tid})
+            if r.status_code != 200:
+                problems.append(f"traced query failed: {r.status_code}")
+            if (r.get_json() or {}).get("trace_id") != tid:
+                problems.append("gateway did not echo the pinned trace id")
+
+            # Stitch via the real CLI. Worker journal writes are
+            # line-buffered, but give the pop→journal hop a beat.
+            records, pids = [], set()
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                records = _stitch_via_cli(log_dir, tid)
+                pids = {(rec.get("role"), rec.get("pid")) for rec in records}
+                if len(pids) >= 3:
+                    break
+                time.sleep(0.25)
+            if len(pids) < 3:
+                problems.append(
+                    f"trace {tid} stitched only {len(pids)} processes "
+                    f"({sorted(pids)}), expected >= 3")
+            if not any(rec.get("kind") == "bus" for rec in records):
+                problems.append("no bus hop in the stitched trace")
+
+            # Prometheus exposition must line-parse.
+            pr = wsgi.get("/metrics?format=prom")
+            prom_lines = []
+            if pr.status_code != 200:
+                problems.append(f"/metrics?format=prom -> {pr.status_code}")
+            else:
+                prom_lines = pr.get_data(as_text=True).splitlines()
+                bad = [ln for ln in prom_lines
+                       if ln and not _PROM_COMMENT.match(ln)
+                       and not _PROM_SAMPLE.match(ln)]
+                if bad:
+                    problems.append(f"unparseable prom lines: {bad[:3]}")
+                if not any(ln.startswith("rafiki_predictor_queries")
+                           for ln in prom_lines):
+                    problems.append(
+                        "rafiki_predictor_queries missing from exposition")
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.kill()
+            journal.close()
+            os.environ.pop("RAFIKI_LOG_DIR", None)
+
+        out = {
+            "trace_id": tid,
+            "trace_records": len(records),
+            "trace_processes": len(pids),
+            "prom_lines": len(prom_lines),
+            # lint: disable=RF007 — smoke artifact wall-clock
+            "wall_s": round(time.monotonic() - t0, 3),
+        }
+        if problems:
+            out["problems"] = problems
+        print(json.dumps(out))
+        return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

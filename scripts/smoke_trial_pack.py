@@ -113,6 +113,7 @@ def main() -> int:
             "packed_trials": counters.get("worker.packed_trials", 0.0),
             "scores": [round(float(t["score"]), 4) for t in trials
                        if t["score"] is not None],
+            # lint: disable=RF007 — smoke artifact wall-clock
             "wall_s": round(time.monotonic() - t0, 3),
         }
         if problems:
