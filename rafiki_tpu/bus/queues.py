@@ -32,8 +32,10 @@ telemetry as ``bus.reaped_workers``), so dead ids stop accumulating.
 Chaos hooks (docs/chaos.md): ``bus.add_query`` (drop/delay a fan-out
 message), ``bus.put_prediction`` (drop/delay a reply) and
 ``bus.heartbeat`` (skip a lease refresh — how scenarios simulate a
-stalled or dead worker without killing the thread). All keyed by
-worker id; inert no-ops unless ``RAFIKI_CHAOS`` is set.
+stalled or dead worker without killing the thread), all keyed by
+worker id; plus ``bus.proxy`` on the mp bus (an injected
+Manager-proxy fault at the IPC round-trip, keyed by the bus verb).
+All inert no-ops unless ``RAFIKI_CHAOS`` is set.
 """
 
 from __future__ import annotations
@@ -78,8 +80,19 @@ def _envelope(query_id: str, query: Any,
 
 class InProcBus:
     _EXPIRED_CAP = 4096  # remembered timed-out query ids (leak guard)
+    # Auto-janitor factor: get_workers reaps any lease older than
+    # REAP_FACTOR × the caller's max_age_s on sight, so corpse queues
+    # cannot grow unboundedly under worker churn even when nothing ever
+    # calls reap_stale explicitly. Well above the liveness TTL: a busy
+    # host starving a worker for a beat or two must not lose its queue.
+    # Env override: RAFIKI_BUS_REAP_FACTOR.
+    REAP_FACTOR = 6.0
 
     def __init__(self):
+        import os
+
+        self._reap_factor = float(
+            os.environ.get("RAFIKI_BUS_REAP_FACTOR", str(self.REAP_FACTOR)))
         # Queues exist exactly while their worker is registered:
         # created in add_worker, destroyed in remove_worker, and
         # add_query drops (rather than resurrects) queries to dead
@@ -138,8 +151,38 @@ class InProcBus:
                 return sorted(ws)
             # lint: disable=RF007 — lease cutoff timestamp, not a duration
             cutoff = time.monotonic() - max_age_s
+            # Auto-janitor: any lease REAP_FACTOR×TTL old is a corpse
+            # (a SIGKILLed worker never runs remove_worker) — reap its
+            # registration, timestamp and pending-query queue on sight,
+            # inline under the same lock (calling reap_stale here would
+            # deadlock on the non-reentrant bus lock).
+            self._reap_locked(cutoff - max_age_s * (self._reap_factor - 1.0),
+                              [job_id])
             return sorted(w for w in ws
                           if self._worker_ts.get((job_id, w), 0.0) >= cutoff)
+
+    def _reap_locked(self, cutoff: float,
+                     jobs: List[str]) -> List[Tuple[str, str]]:
+        """Delete registrations with leases older than ``cutoff``.
+        Caller holds ``self._lock``."""
+        reaped: List[Tuple[str, str]] = []
+        for j in jobs:
+            ws = self._workers.get(j)
+            if not ws:
+                continue
+            for w in [w for w in ws
+                      if self._worker_ts.get((j, w), 0.0) < cutoff]:
+                ws.discard(w)
+                # lint: disable=RF004 — caller holds self._lock (see docstring)
+                self._worker_ts.pop((j, w), None)
+                # lint: disable=RF004 — caller holds self._lock (see docstring)
+                q = self._queues.pop(w, None)
+                if q is not None:
+                    self._depth = max(0, self._depth - q.qsize())
+                reaped.append((j, w))
+        if reaped:
+            telemetry.inc("bus.reaped_workers", len(reaped))
+        return reaped
 
     def reap_stale(self, max_age_s: float,
                    job_id: Optional[str] = None) -> List[Tuple[str, str]]:
@@ -148,27 +191,13 @@ class InProcBus:
         queue, so a SIGKILLed worker's leftovers stop accumulating.
         Callers pick max_age_s well above the liveness TTL (the
         predictor uses k×TTL): reaping is for corpses, not for workers
-        a busy host merely starved for one beat."""
+        a busy host merely starved for one beat. ``get_workers`` also
+        runs this automatically at REAP_FACTOR× the caller's TTL."""
         # lint: disable=RF007 — lease cutoff timestamp, not a duration
         cutoff = time.monotonic() - max_age_s
-        reaped: List[Tuple[str, str]] = []
         with self._lock:
             jobs = [job_id] if job_id is not None else list(self._workers)
-            for j in jobs:
-                ws = self._workers.get(j)
-                if not ws:
-                    continue
-                for w in [w for w in ws
-                          if self._worker_ts.get((j, w), 0.0) < cutoff]:
-                    ws.discard(w)
-                    self._worker_ts.pop((j, w), None)
-                    q = self._queues.pop(w, None)
-                    if q is not None:
-                        self._depth = max(0, self._depth - q.qsize())
-                    reaped.append((j, w))
-        if reaped:
-            telemetry.inc("bus.reaped_workers", len(reaped))
-        return reaped
+            return self._reap_locked(cutoff, jobs)
 
     # -- queries -------------------------------------------------------------
 
@@ -300,8 +329,13 @@ class _MpBus:
     """
 
     _EXPIRED_CAP = 4096  # remembered gathered/timed-out query ids
+    REAP_FACTOR = 6.0    # same auto-janitor contract as InProcBus
 
     def __init__(self, manager):
+        import os
+
+        self._reap_factor = float(
+            os.environ.get("RAFIKI_BUS_REAP_FACTOR", str(self.REAP_FACTOR)))
         self._manager = manager         # keepalive only; dropped on pickle
         self._queues = manager.dict()   # worker_id -> tuple of (qid, query)
         self._preds = manager.dict()    # query_id -> tuple of (worker, pred)
@@ -315,6 +349,16 @@ class _MpBus:
         state = self.__dict__.copy()
         state["_manager"] = None  # children use proxies, never the manager
         return state
+
+    @staticmethod
+    def _proxy(op: str):
+        """``bus.proxy`` chaos site (docs/chaos.md): an injected
+        Manager-proxy fault at the start of an IPC round-trip, keyed by
+        the bus verb. ``error`` raises ChaosError in the calling
+        process (a dead manager / broken pipe), ``delay`` stalls the
+        round-trip; the caller's own error handling — breakers, quorum
+        gathers, lease expiry — must absorb it."""
+        return _chaos("bus.proxy", op)
 
     def add_worker(self, job_id, worker_id):
         with self._lock:
@@ -342,11 +386,19 @@ class _MpBus:
                 self._worker_ts[f"{job_id}|{worker_id}"] = time.time()
 
     def get_workers(self, job_id, max_age_s=None):
+        self._proxy("get_workers")
         ws = self._workers.get(job_id, ())
         if max_age_s is None:
             return sorted(ws)
         cutoff = time.time() - max_age_s
         ts = dict(self._worker_ts)
+        # Auto-janitor (same contract as InProcBus.get_workers): the
+        # stale set is computed from this read's snapshot, then reaped
+        # through reap_stale — a lock-free read here, so no deadlock.
+        reap_age = max_age_s * self._reap_factor
+        if any(ts.get(f"{job_id}|{w}", 0.0) < time.time() - reap_age
+               for w in ws):
+            self.reap_stale(reap_age, job_id)
         return sorted(w for w in ws
                       if ts.get(f"{job_id}|{w}", 0.0) >= cutoff)
 
@@ -379,6 +431,7 @@ class _MpBus:
         if _chaos("bus.add_query", worker_id) == "drop":
             telemetry.inc("bus.queries_dropped_chaos")
             return
+        self._proxy("add_query")
         item = _envelope(query_id, query, trace)
         with self._lock:
             pending = self._queues.get(worker_id)
@@ -392,6 +445,7 @@ class _MpBus:
         return len(self._queues.get(worker_id, ()))
 
     def pop_queries(self, worker_id, max_n=64, timeout=0.1):
+        self._proxy("pop_queries")
         deadline = time.monotonic() + timeout
         while True:
             with self._lock:
@@ -409,6 +463,7 @@ class _MpBus:
     def put_prediction(self, query_id, worker_id, prediction):
         if _chaos("bus.put_prediction", worker_id) == "drop":
             return
+        self._proxy("put_prediction")
         with self._lock:
             if query_id in self._expired:
                 return  # late answer to a timed-out query: drop, don't leak

@@ -15,9 +15,12 @@ import sys
 def main(argv=None) -> int:
     # Before ANYTHING imports jax (analysis rule RF001): scenario
     # clusters run on whatever platform the env pins — CPU in CI.
-    from rafiki_tpu.utils.backend import honor_env_platform
+    from rafiki_tpu.utils.backend import ensure_host_device_count, honor_env_platform
 
     honor_env_platform()
+    # Mesh scenarios (docs/mesh_sweep.md) need a multi-chip pod; on the
+    # CPU fake this is 8 virtual devices, same as the test suite.
+    ensure_host_device_count(8)
 
     from rafiki_tpu.chaos.runner import (
         SCENARIOS, format_report, run_scenarios)

@@ -37,6 +37,8 @@ from rafiki_tpu.obs.journal import journal
 _RECOVERY_SCENARIOS = frozenset({
     "kill-mid-trial-resume", "kill-mid-pack-resume",
     "checkpoint-write-failure", "drain-under-load",
+    "mesh-chip-loss-repack", "collective-kill-mid-step",
+    "mesh-degrades-single-chip",
 })
 
 # Subprocess-killing scenarios must be reconstructible from the
@@ -46,6 +48,7 @@ _RECOVERY_SCENARIOS = frozenset({
 # including the flight record the scheduler dumps for the dead worker.
 _JOURNALED_SCENARIOS = frozenset({
     "kill-mid-trial-resume", "kill-mid-pack-resume",
+    "collective-kill-mid-step",
 })
 
 

@@ -36,6 +36,22 @@ predictor-outage-surfaces      every bus heartbeat skipped: the bounded
 checkpoint-write-failure       every checkpoint write errors; the trial
                                still completes (resumability lost, work
                                kept) and the failure is counted
+mesh-chip-loss-repack          a chip preempted mid-sweep: the mesh
+                               supervisor re-packs its RUNNING trials onto
+                               the survivor, every trial completes with a
+                               score, and resumed params bit-match
+                               unfaulted serial runs
+pack-straggler-evict           one pack member early-stops epochs before
+                               its mates: it is evicted from the stacked
+                               state mid-pack, its slot backfilled with a
+                               freshly proposed trial, and the evictee
+                               bit-matches a serial early-stopped run
+collective-kill-mid-step       a dp-mesh worker SIGKILLed inside the
+                               collective step path; the respawn resumes
+                               from checkpoint and finishes the budget
+mesh-degrades-single-chip      every mesh-formation attempt fails: the
+                               sweep degrades to single-chip mode inside
+                               its grace window and still completes
 =============================  =============================================
 """
 
@@ -138,7 +154,7 @@ def _check_rows(check, store, job_id, expect: int):
     return trials
 
 
-def _params_match_serial(check, params, trials):
+def _params_match_serial(check, params, trials, source=None, cls_name=None):
     """Bit-match invariant: each resumed trial's persisted params equal
     a fresh unfaulted serial train() with the same knobs (seed knob
     defaults identically), leaf for leaf."""
@@ -147,7 +163,7 @@ def _params_match_serial(check, params, trials):
     from rafiki_tpu.model.base import load_model_class
     from rafiki_tpu.utils.serial import load_pytree
 
-    cls = load_model_class(FF_SOURCE, "ChaosFF")
+    cls = load_model_class(source or FF_SOURCE, cls_name or "ChaosFF")
 
     def leaves(blob: bytes):
         import pickle
@@ -461,3 +477,222 @@ def predictor_outage_surfaces(tmp, check: CheckFn) -> None:
     finally:
         stop.set()
         th.join(timeout=2)
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sweep / elastic-pack scenarios (docs/mesh_sweep.md)
+# ---------------------------------------------------------------------------
+
+# ChaosFF plus an early-stop rule keyed off learning_rate — a DYNAMIC
+# knob, so a high-lr (early-stopping) member and a low-lr (full-budget)
+# member still share one packing key / compiled program and can train
+# in the same pack.
+EVICT_SOURCE = b"""
+from rafiki_tpu.model.base import JaxModel
+from rafiki_tpu.model.knobs import FixedKnob, FloatKnob
+from rafiki_tpu.models.ff import _Mlp
+
+class EvictFF(JaxModel):
+    @staticmethod
+    def get_knob_config():
+        return {
+            "hidden_units": FixedKnob(16),
+            "learning_rate": FloatKnob(1e-3, 3e-2, is_exp=True),
+            "batch_size": FixedKnob(32),
+            "epochs": FixedKnob(3),
+        }
+
+    def build_module(self, num_classes, input_shape):
+        return _Mlp(hidden_layers=1,
+                    hidden_units=int(self.knobs["hidden_units"]),
+                    num_classes=num_classes)
+
+    def should_stop_early(self, epoch, metrics):
+        # A high-lr member "converges" after its first epoch: the
+        # deterministic straggler-eviction trigger.
+        return float(self.knobs["learning_rate"]) >= 0.02
+"""
+
+
+def _journal_has(recs, kind: str, name: str) -> bool:
+    return any(r.get("kind") == kind and r.get("name") == name for r in recs)
+
+
+@scenario(
+    "mesh-chip-loss-repack",
+    "Preempt chip 1 of a 2-chip mesh sweep mid-pack: the supervisor "
+    "must re-pack its RUNNING trials onto the survivor, every trial "
+    "completes with a score, resumed params bit-match unfaulted serial "
+    "runs, and the loss/re-pack story reads back out of the journals.",
+    spec="seed=11;scheduler.preempt:kill:after=2:times=1:match=chip1",
+    env={"RAFIKI_CHECKPOINT_EVERY": "1"},
+)
+def mesh_chip_loss_repack(tmp, check: CheckFn) -> None:
+    from rafiki_tpu import chaos, telemetry
+    from rafiki_tpu.obs import journal as journal_mod
+    from rafiki_tpu.obs.ledger import ledger
+    from rafiki_tpu.scheduler import MeshSweepScheduler
+
+    store, params, model = _train_env(tmp)
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 4})
+    sched = MeshSweepScheduler(store, params)
+    result = sched.run_sweep(job["id"], chips=2, trials_per_chip=2,
+                             advisor_kind="random")
+    check("job_completed", result.status == "COMPLETED", result.errors)
+    trials = _check_rows(check, store, job["id"], expect=4)
+    check("all_scores_recorded",
+          all(t.get("score") is not None for t in trials),
+          f"scores: {[t.get('score') for t in trials]}")
+    check("chip_loss_counted",
+          telemetry.get_counter("mesh.chips_lost") >= 1.0,
+          "no mesh.chips_lost increments")
+    # The kill really fired, against chip1 specifically.
+    plane = chaos.active()
+    fired = [] if plane is None else plane.schedule()
+    check("preempt_fired",
+          any(site == "scheduler.preempt" and key == "chip1"
+              for site, _mode, _hit, key in fired),
+          f"schedule: {fired}")
+    # Re-pack work must land on the survivor: some trial finished under
+    # a worker other than chip1's.
+    workers = {t.get("worker_id") for t in trials}
+    check("survivor_finished_trials",
+          any(w and w.endswith("-mesh-c0") for w in workers),
+          f"worker ids: {sorted(w or '' for w in workers)}")
+    # Reconstructible from the journals alone (single-process sweep, so
+    # the runner-side multi-pid checks don't apply — assert here).
+    recs = journal_mod.read_dir(journal_mod.journal.log_dir)
+    check("journal_records_chip_loss", _journal_has(recs, "mesh", "chip_lost"),
+          "no mesh/chip_lost journal record")
+    check("journal_records_repack", _journal_has(recs, "mesh", "repack"),
+          "no mesh/repack journal record")
+    # Recovery cost charged to the sweep's downtime bucket.
+    ent = ledger.snapshot()["entities"].get(f"mesh:{job['id']}", {})
+    check("downtime_charged", ent.get("downtime_s", 0.0) > 0.0, ent)
+    _params_match_serial(check, params, trials)
+
+
+@scenario(
+    "pack-straggler-evict",
+    "One member of a k=2 pack early-stops at epoch 0 while its mate "
+    "trains the full budget: the straggler must be EVICTED from the "
+    "stacked state mid-pack, its slot backfilled with a freshly "
+    "proposed trial, all three trials complete, and the evictee "
+    "bit-matches a serial early-stopped run.",
+    spec="seed=11;worker.epoch:delay:delay=0.05:times=1",
+)
+def pack_straggler_evict(tmp, check: CheckFn) -> None:
+    from rafiki_tpu import telemetry
+    from rafiki_tpu.advisor import AdvisorService
+    from rafiki_tpu.model.base import load_model_class
+    from rafiki_tpu.model.knobs import knob_config_signature
+    from rafiki_tpu.store import MetaStore, ParamsStore
+    from rafiki_tpu.worker.train import (InProcAdvisorHandle,
+                                         PackedTrialRunner, TrainWorker)
+
+    store = MetaStore(tmp / "meta.sqlite3")
+    params = ParamsStore(tmp / "params")
+    model = store.create_model("evictff", "IMAGE_CLASSIFICATION", None,
+                               EVICT_SOURCE, "EvictFF")
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 3})
+    sub = store.get_sub_train_jobs(job["id"])[0]
+    cls = load_model_class(EVICT_SOURCE, "EvictFF")
+    advisors = AdvisorService()
+    advisor_id = advisors.create_advisor(cls.get_knob_config(), kind="random")
+    worker = TrainWorker(
+        store, params, sub["id"], cls,
+        InProcAdvisorHandle(advisors, advisor_id), TRAIN, VAL,
+        {"MODEL_TRIAL_COUNT": 3}, worker_id="evict-w0", async_persist=False)
+    knob_config = cls.get_knob_config()
+    base = {"hidden_units": 16, "batch_size": 32, "epochs": 3}
+    rows = []
+    # lr >= 0.02 trips EvictFF.should_stop_early at epoch 0 — a
+    # straggler next to a full-budget mate.
+    for kn in (dict(base, learning_rate=0.025),
+               dict(base, learning_rate=0.005)):
+        trial = store.create_trial(sub["id"], "EvictFF", kn,
+                                   shape_sig=knob_config_signature(
+                                       knob_config, kn),
+                                   budget_max=3)
+        rows.append((trial["id"], kn))
+    n = PackedTrialRunner(worker, 2).run_assigned(rows, budget_max=3)
+    # 2 assigned + 1 backfilled into the evicted straggler's slot.
+    check("all_rows_carried", n == 3, f"carried {n}, want 3")
+    trials = _check_rows(check, store, job["id"], expect=3)
+    check("straggler_evicted",
+          telemetry.get_counter("trial_pack.evictions") >= 1.0,
+          "no trial_pack.evictions increments")
+    check("slot_backfilled",
+          telemetry.get_counter("trial_pack.backfills") >= 1.0,
+          "no trial_pack.backfills increments")
+    check("all_scores_recorded",
+          all(t.get("score") is not None for t in trials),
+          f"scores: {[t.get('score') for t in trials]}")
+    _params_match_serial(check, params, trials,
+                         source=EVICT_SOURCE, cls_name="EvictFF")
+
+
+@scenario(
+    "collective-kill-mid-step",
+    "SIGKILL a dp-mesh worker inside the collective step path (the "
+    "collective.step site fires each epoch a mesh plan is live). The "
+    "respawned worker must adopt, resume from the epoch checkpoint and "
+    "finish the budget. No bit-match here: dp gradient reduction order "
+    "differs from serial by design.",
+    spec="seed=13;collective.step:kill:after=1:times=1:unless=-r",
+    env={"RAFIKI_CHECKPOINT_EVERY": "1", "RAFIKI_WORKER_MAX_RESTARTS": "3",
+         "RAFIKI_WORKER_RESTART_BACKOFF_S": "0.2"},
+)
+def collective_kill_mid_step(tmp, check: CheckFn) -> None:
+    from rafiki_tpu.scheduler import ProcessScheduler
+
+    store, params, model = _train_env(tmp)
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 2})
+    sched = ProcessScheduler(store, params)
+    result = sched.run_train_job(job["id"], n_workers=1, devices_per_trial=2,
+                                 advisor_kind="random", platform="cpu")
+    check("job_completed", result.status == "COMPLETED", result.errors)
+    trials = _check_rows(check, store, job["id"], expect=2)
+    resumed = [t for t in trials if "-r" in (t["worker_id"] or "")]
+    check("trial_finished_by_respawned_worker", len(resumed) >= 1,
+          f"worker ids: {[t['worker_id'] for t in trials]}")
+    _no_corrupt_checkpoints(check, params, trials)
+
+
+@scenario(
+    "mesh-degrades-single-chip",
+    "Every mesh-formation attempt fails (injected collective.init "
+    "errors past the retry budget): the sweep must DEGRADE to "
+    "single-chip mode inside its grace window — same trials, one chip "
+    "— and still complete, with the downgrade journaled.",
+    spec="seed=17;collective.init:error:times=8",
+    env={"RAFIKI_MESH_INIT_RETRIES": "2", "RAFIKI_MESH_INIT_BACKOFF_S": "0.01",
+         "RAFIKI_MESH_FORM_GRACE_S": "5"},
+)
+def mesh_degrades_single_chip(tmp, check: CheckFn) -> None:
+    from rafiki_tpu import telemetry
+    from rafiki_tpu.obs import journal as journal_mod
+    from rafiki_tpu.scheduler import MeshSweepScheduler
+
+    store, params, model = _train_env(tmp)
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 2})
+    sched = MeshSweepScheduler(store, params)
+    result = sched.run_sweep(job["id"], chips=2, trials_per_chip=2,
+                             advisor_kind="random")
+    check("job_completed", result.status == "COMPLETED", result.errors)
+    trials = _check_rows(check, store, job["id"], expect=2)
+    check("degradation_counted",
+          telemetry.get_counter("mesh.degraded_single_chip") >= 1.0,
+          "no mesh.degraded_single_chip increments")
+    check("init_retries_counted",
+          telemetry.get_counter("mesh.init_retries") >= 2.0,
+          "no mesh.init_retries increments")
+    workers = {t.get("worker_id") for t in trials}
+    check("single_chip_ran_everything",
+          all(w and w.endswith("-mesh-c0") for w in workers),
+          f"worker ids: {sorted(w or '' for w in workers)}")
+    recs = journal_mod.read_dir(journal_mod.journal.log_dir)
+    check("journal_records_degradation",
+          _journal_has(recs, "mesh", "degraded"),
+          "no mesh/degraded journal record")
+    _params_match_serial(check, params, trials)

@@ -172,6 +172,16 @@ class JaxModel(BaseModel):
         loss, acc = cross_entropy_loss(logits, batch["y"])
         return loss, {"acc": acc}
 
+    def should_stop_early(self, epoch: int, metrics: Dict[str, float]) -> bool:
+        """Per-epoch early-stop hook: return True to end training after
+        ``epoch`` (metrics are that epoch's train metrics). Honoured by
+        both the serial ``train()`` loop and ``train_packed`` — a packed
+        member whose stop fires before its pack-mates is EVICTED from
+        the stacked state mid-pack and its slot backfilled
+        (docs/mesh_sweep.md), with the evicted member's params
+        bit-matching the serial early-stopped run."""
+        return False
+
     # -- internal wiring -----------------------------------------------------
 
     def set_mesh(self, mesh) -> None:
@@ -318,6 +328,8 @@ class JaxModel(BaseModel):
                 # The sink decides whether to materialize this epoch's
                 # snapshot (dump is a device fetch — not free).
                 self._ckpt_sink(epoch, self.dump_checkpoint)
+            if self.should_stop_early(epoch, metrics):
+                break
 
     def evaluate(self, dataset_uri: str) -> float:
         if self._loop is None:
@@ -350,8 +362,8 @@ class JaxModel(BaseModel):
 
     @classmethod
     def train_packed(cls, models: List["JaxModel"], dataset_uri: str,
-                     on_epoch=None,
-                     checkpoint_sink=None) -> List[List[Dict[str, float]]]:
+                     on_epoch=None, checkpoint_sink=None,
+                     backfill=None, on_evict=None) -> List[List[Dict[str, float]]]:
         """Train k model instances as ONE vmapped program on one device.
 
         All models must share a packing_key (the caller buckets).
@@ -359,24 +371,40 @@ class JaxModel(BaseModel):
         rng chain and shuffle order a serial ``train()`` with its seed
         would produce. Returns per-model epoch histories (list of
         ``{"loss": ..., "acc": ..., "epoch": e}`` dicts) — the caller
-        writes them to each trial's log. ``on_epoch(epoch)`` fires
-        after every packed epoch (worker heartbeats).
+        writes them to each trial's log. ``on_epoch(round)`` fires
+        after every packed round (worker heartbeats).
 
-        ``checkpoint_sink(epoch, make_blobs)``, when given, fires after
-        each epoch BEFORE ``on_epoch``; ``make_blobs()`` materializes k
-        per-trial checkpoint blobs in model order, each identical in
-        format to a serial ``dump_checkpoint`` — sliced out of the live
-        pack (``trial_state(i)`` device views, host copies pipelined)
-        without serializing the stacked state. A packed trial's
+        ``checkpoint_sink(round, make_blobs)``, when given, fires after
+        each round BEFORE ``on_epoch``; ``make_blobs()`` materializes
+        one serial-format checkpoint blob per CURRENT pack member,
+        returned as ``[(model_index, epoch, blob), ...]`` — sliced out of the
+        live pack (``trial_state(i)`` device views, host copies
+        pipelined) without serializing the stacked state, each stamped
+        with that member's OWN epoch counter. A packed trial's
         checkpoint therefore restores through the ordinary serial
         resume path (docs/trial_packing.md).
+
+        Elastic membership (docs/mesh_sweep.md): a member whose
+        ``should_stop_early`` fires (or whose epoch budget completes)
+        epochs before its pack-mates is EVICTED — its state is sliced
+        out of the pack into a detached serial ``TrainLoop`` (so it
+        still evaluates/serves/checkpoints normally and bit-matches a
+        serial run) and ``on_evict(model_index, epoch, reason)`` fires
+        with reason ``"early_stop"`` or ``"finished"``. When
+        ``backfill(n)`` is given it is called with the vacancy count
+        and may return freshly-proposed models (same packing_key);
+        they are appended to ``models``/the returned histories and
+        admitted into the freed slots mid-pack, starting at their own
+        epoch 0. When every remaining member leaves in the same round,
+        the pack ends and members keep live slice views (the shared
+        ``evaluate_packed`` fast path).
 
         Not supported in a pack (callers enforce; asserted here):
         meshes (the trial axis IS the parallelism), checkpoint-resume
         (``_start_epoch > 0`` — an interrupted pack member resumes
         SERIALLY from its slice checkpoint), masked datasets.
         """
-        from rafiki_tpu.ops.train import PackedTrainLoop
+        from rafiki_tpu.ops.train import PackedTrainLoop, TrainLoop
 
         if not models:
             return []
@@ -415,32 +443,100 @@ class JaxModel(BaseModel):
         arch = (num_classes, tuple(input_shape))
         planned = epochs * max(1, ds.size // batch_size)
         portable = _portable_meta(dict(ds.meta))
-        for epoch in range(epochs):
-            # Serial parity: trial i's shuffle seed is seed_i + epoch,
-            # exactly what train() passes to run_epoch.
-            mts = packed.run_epoch(ds, batch_size,
-                                   [m._seed + epoch for m in models])
-            for i, mt in enumerate(mts):
-                histories[i].append(dict(mt, epoch=epoch))
+        pack_hypers = {i: hypers[i] for i in range(len(models))}
+
+        def install_detached(mi: int, state, epoch: int) -> None:
+            """Evicted member keeps training-equivalent state through an
+            ordinary serial loop (same cached Program — ``hyper`` must
+            be passed so dynamic_lr matches the pack's trace)."""
+            m = models[mi]
+            m._module = fns["module"]
+            m._loop = TrainLoop(
+                fns["init_fn"], fns["apply_eval"], fns["loss_fn"],
+                fns["optimizer"], seed=m._seed, hyper=pack_hypers[mi],
+                program_key=fns["program_key"], initial_state=state)
+            m._arch = arch
+            m._epochs_done = epoch
+
+        slots = list(range(len(models)))  # slot j <-> packed member j
+        epochs_done = {mi: 0 for mi in slots}  # epochs COMPLETED so far
+        rnd = 0
+        while slots:
+            # Serial parity: trial i's shuffle seed is seed_i + its OWN
+            # epoch index, exactly what train() passes to run_epoch —
+            # backfilled members count from their own epoch 0.
+            mts = packed.run_epoch(
+                ds, batch_size,
+                [models[mi]._seed + epochs_done[mi] for mi in slots])
+            for j, mi in enumerate(slots):
+                histories[mi].append(dict(mts[j], epoch=epochs_done[mi]))
             if checkpoint_sink is not None:
+                ents = tuple((mi, epochs_done[mi]) for mi in slots)
                 checkpoint_sink(
-                    epoch,
-                    lambda e=epoch: cls._packed_checkpoint_blobs(
+                    rnd,
+                    lambda e=ents: cls._packed_checkpoint_blobs(
                         packed, arch, e, planned, portable))
             if on_epoch is not None:
-                on_epoch(epoch)
+                on_epoch(rnd)
+            rnd += 1
 
-        for i, m in enumerate(models):
-            m._module = fns["module"]
-            m._loop = packed.slice(i)
-            m._arch = (num_classes, tuple(input_shape))
-            m._epochs_done = epochs - 1
+            leavers = []  # (slot, model_index, just-run epoch, reason)
+            for j, mi in enumerate(slots):
+                e = epochs_done[mi]
+                if e + 1 >= epochs:
+                    leavers.append((j, mi, e, "finished"))
+                elif models[mi].should_stop_early(e, mts[j]):
+                    leavers.append((j, mi, e, "early_stop"))
+            for mi in slots:
+                epochs_done[mi] += 1
+
+            if len(leavers) == len(slots):
+                # Whole pack ends together: keep live slice views so
+                # evaluate_packed scores everyone in ONE shared pass.
+                for j, mi, e, reason in leavers:
+                    m = models[mi]
+                    m._module = fns["module"]
+                    m._loop = packed.slice(j)
+                    m._arch = arch
+                    m._epochs_done = e
+                    if on_evict is not None and reason == "early_stop":
+                        on_evict(mi, e, reason)
+                break
+
+            # Stragglers-in-reverse: some members are done early —
+            # slice them out (descending slot so indices stay valid).
+            for j, mi, e, reason in sorted(leavers, reverse=True):
+                install_detached(mi, packed.evict(j), e)
+                slots.pop(j)
+                if on_evict is not None:
+                    on_evict(mi, e, reason)
+
+            if leavers and backfill is not None:
+                for m2 in (backfill(len(leavers)) or []):
+                    mf2 = m2.packing_key(ds)  # sets _planned_steps
+                    if repr(mf2) != repr(keys[id(lead)]):
+                        raise ValueError(
+                            "backfill model's packing_key differs from the "
+                            "live pack's; the caller must bucket first")
+                    m2._dataset_meta = dict(ds.meta)
+                    hyper2 = m2._loop_fns(num_classes, input_shape)["hyper"]
+                    mi2 = len(models)
+                    models.append(m2)
+                    histories.append([])
+                    pack_hypers[mi2] = hyper2
+                    packed.admit(m2._seed, hyper2)
+                    slots.append(mi2)
+                    epochs_done[mi2] = 0
         return histories
 
     @staticmethod
-    def _packed_checkpoint_blobs(packed, arch, epoch: int, planned_steps,
-                                 dataset_meta) -> List[bytes]:
-        """k serial-format checkpoint blobs out of a live pack.
+    def _packed_checkpoint_blobs(packed, arch, entries, planned_steps,
+                                 dataset_meta) -> List[tuple]:
+        """Serial-format checkpoint blobs out of a live pack, one per
+        CURRENT member. ``entries`` is ``[(model_index, epoch), ...]``
+        aligned with pack slots 0..k-1 (members evicted/backfilled
+        mid-sweep carry their OWN epoch counters); the return is
+        ``[(model_index, epoch, blob), ...]``.
 
         The pack is NOT serialized: each trial's state is a device-side
         slice view (``trial_state(i)`` = ``tree.map(a[i])``), and every
@@ -460,7 +556,7 @@ class JaxModel(BaseModel):
                 if hasattr(leaf, "copy_to_host_async"):
                     leaf.copy_to_host_async()
         blobs = []
-        for st in states:
+        for st, (mi, epoch) in zip(states, entries):
             payload = {
                 "arch": arch,
                 "state_packed": dump_pytree(st, cast_f32_to_bf16=False),
@@ -468,7 +564,7 @@ class JaxModel(BaseModel):
                 "planned_steps": planned_steps,
                 "dataset_meta": dataset_meta,
             }
-            blobs.append(pickle.dumps(payload))
+            blobs.append((mi, epoch, pickle.dumps(payload)))
         return blobs
 
     @classmethod

@@ -452,12 +452,18 @@ class TrainLoop:
     program_key: optional hashable. When given, the compiled Program is
         fetched from / stored in the process-wide cache under
         (program_key, mesh) — the compile-amortization path.
+    initial_state: optional full (params, opt_state, step, rng, hyper)
+        tuple to adopt INSTEAD of running init — the detached-member
+        path: a trial evicted from a pack mid-sweep continues (or just
+        evaluates/serves) through an ordinary serial loop holding the
+        state sliced out of the stacked pack.
     """
 
     def __init__(self, init_fn, apply_fn, loss_fn, optimizer=None,
                  mesh: Optional[Mesh] = None, seed: int = 0,
                  hyper: Optional[Dict[str, float]] = None,
-                 program_key: Optional[Hashable] = None):
+                 program_key: Optional[Hashable] = None,
+                 initial_state=None):
         dynamic_lr = hyper is not None and "lr" in hyper
         if optimizer is None:
             optimizer = optax.scale_by_adam() if dynamic_lr else optax.adam(1e-3)
@@ -479,6 +485,9 @@ class TrainLoop:
         self._eval_step = self.program.eval_step
         self._predict = self.program.predict
 
+        if initial_state is not None:
+            self.state = self.plan.put_state(initial_state)
+            return
         hyper_dev = {k: jnp.float32(v) for k, v in (hyper or {}).items()}
         rng = jax.random.PRNGKey(seed)
         rng, init_rng = jax.random.split(rng)
@@ -512,6 +521,22 @@ class TrainLoop:
             raise ValueError(
                 f"Dataset has {dataset.size} examples < batch_size={batch_size}; "
                 f"the epoch would run zero steps")
+        if self.plan.mesh is not None:
+            # Chaos site for collective streams: every epoch of a dp
+            # (possibly multi-process) run passes through here, so a
+            # kill keyed to a follower process lands while its peers
+            # are inside (or about to enter) the epoch's all-reduces —
+            # the distributed-training failure mode the scheduler's
+            # whole-group teardown exists for. Keyed by process index
+            # AND worker id (the id carries the -rN restart suffix, so
+            # `unless=-r` scopes a kill to the first incarnation).
+            import os as _os
+
+            from rafiki_tpu import chaos as _chaos
+
+            _chaos.hook("collective.step",
+                        key=f"p{jax.process_index()}:"
+                            f"{_os.environ.get('RAFIKI_WORKER_ID', '')}")
         t_epoch = time.monotonic()
         if on_metrics is None and self._fits_device_fast_path(dataset):
             X, Y = get_device_dataset(dataset)
@@ -740,19 +765,13 @@ class PackedTrainLoop:
         dynamic_lr = "lr" in hypers[0]
         if optimizer is None:
             optimizer = optax.scale_by_adam() if dynamic_lr else optax.adam(1e-3)
-        k = self.k
-
-        def build() -> PackedProgram:
-            return PackedProgram(init_fn, apply_fn, loss_fn, optimizer, k,
-                                 dynamic_lr=dynamic_lr)
-
-        if program_key is not None:
-            self.program = get_program(
-                packed_program_key(program_key, k, dynamic_lr), build)
-        else:
-            self.program = build()
-        self.plan = self.program.plan
-        self.optimizer = self.program.optimizer
+        # The build inputs outlive __init__: evict/admit change the pack
+        # width k, and width is part of the packed program key, so every
+        # re-pack fetches (or builds) the program at the new width.
+        self._fns = (init_fn, apply_fn, loss_fn, optimizer)
+        self._program_key = program_key
+        self._dynamic_lr = dynamic_lr
+        self._set_program()
 
         # Per-trial rng derivation matches TrainLoop exactly: key(seed)
         # split once; row 0 carries on as the step rng, row 1 seeds init.
@@ -763,8 +782,80 @@ class PackedTrainLoop:
         hyper_dev = {name: jnp.asarray([float(h[name]) for h in hypers],
                                        jnp.float32)
                      for name in hypers[0]}
-        self.state = (params, opt_state, jnp.zeros((k,), jnp.int32),
+        self.state = (params, opt_state, jnp.zeros((self.k,), jnp.int32),
                       rngs, hyper_dev)
+
+    def _set_program(self) -> None:
+        """(Re)fetch the PackedProgram at the CURRENT width self.k —
+        the packed cache key includes k, so a width change after
+        evict/admit compiles (once, then cached) a new program while
+        per-trial math stays bit-identical (vmap width never enters the
+        per-trial computation)."""
+        init_fn, apply_fn, loss_fn, optimizer = self._fns
+        k, dynamic_lr = self.k, self._dynamic_lr
+
+        def build() -> PackedProgram:
+            return PackedProgram(init_fn, apply_fn, loss_fn, optimizer, k,
+                                 dynamic_lr=dynamic_lr)
+
+        if self._program_key is not None:
+            self.program = get_program(
+                packed_program_key(self._program_key, k, dynamic_lr), build)
+        else:
+            self.program = build()
+        self.plan = self.program.plan
+        self.optimizer = self.program.optimizer
+
+    # -- elastic membership (docs/mesh_sweep.md) -----------------------------
+
+    def evict(self, i: int):
+        """Slice member ``i`` out of the stacked state and narrow the
+        pack to k-1. Returns the evicted member's serial-shaped state
+        (leading trial axis removed) — exactly what a serial
+        ``TrainLoop`` carrying that trial would hold, so the caller can
+        adopt it via ``TrainLoop(initial_state=...)`` or checkpoint it.
+
+        Used for straggler eviction (a member's early-stop fires epochs
+        before its pack-mates) and for re-packing after a lost chip.
+        """
+        if not (0 <= i < self.k):
+            raise IndexError(f"evict {i} out of pack of {self.k}")
+        if self.k == 1:
+            raise ValueError("cannot evict the last pack member")
+        evicted = jax.tree.map(lambda a: a[i], self.state)
+        self.state = jax.tree.map(
+            lambda a: jnp.concatenate([a[:i], a[i + 1:]], axis=0), self.state)
+        self.k -= 1
+        self._set_program()
+        telemetry.inc("trial_pack.evictions")
+        return evicted
+
+    def admit(self, seed: int, hyper: Dict[str, float]) -> int:
+        """Backfill one slot: append a fresh member initialized exactly
+        as a serial ``TrainLoop(seed=seed, hyper=hyper)`` would be and
+        widen the pack to k+1. Returns the new member's slot index.
+
+        The hyper key set must match the pack's (it is part of the
+        traced state structure).
+        """
+        have = tuple(sorted(self.state[4]))
+        want = tuple(sorted(hyper))
+        if have != want:
+            raise ValueError(
+                f"backfill hyper keys {want} != pack hyper keys {have}")
+        keys = jnp.stack([jax.random.PRNGKey(int(seed))])
+        split = jax.vmap(jax.random.split)(keys)
+        rngs, init_rngs = split[:, 0], split[:, 1]
+        params, opt_state = self.program.init(init_rngs)
+        member = (params, opt_state, jnp.zeros((1,), jnp.int32), rngs,
+                  {name: jnp.asarray([float(hyper[name])], jnp.float32)
+                   for name in hyper})
+        self.state = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b], axis=0), self.state, member)
+        self.k += 1
+        self._set_program()
+        telemetry.inc("trial_pack.backfills")
+        return self.k - 1
 
     # -- per-trial views -----------------------------------------------------
 

@@ -11,10 +11,16 @@ TPU-native replacements:
     ``TPU_VISIBLE_CHIPS=<chip>`` (CPU fake: per-process fake chips):
     fully isolated XLA runtimes and compilation caches, the robust
     production shape (SURVEY.md §7 "per-chip trial isolation").
+  * MeshSweepScheduler — the whole mesh as ONE sweep: k packed trials
+    per chip × N chips from a single ``propose_batch(N*k)``, with
+    elastic re-packing on chip loss, collective-init retry and
+    bounded-grace degradation to single-chip mode
+    (docs/mesh_sweep.md).
 """
 
 from rafiki_tpu.scheduler.local import LocalScheduler, TrainJobResult
+from rafiki_tpu.scheduler.mesh import MeshSweepScheduler
 from rafiki_tpu.scheduler.process import ProcessScheduler, worker_device_env
 
-__all__ = ["LocalScheduler", "ProcessScheduler", "TrainJobResult",
-           "worker_device_env"]
+__all__ = ["LocalScheduler", "MeshSweepScheduler", "ProcessScheduler",
+           "TrainJobResult", "worker_device_env"]

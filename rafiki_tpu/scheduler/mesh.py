@@ -1,0 +1,435 @@
+"""Mesh sweep scheduler: k packed trials per chip × N chips, elastic.
+
+The paper's train plane is "one trial per GPU" on a fixed 8-GPU box;
+this module drives the whole 8-chip mesh as ONE sweep: a single
+``Advisor.propose_batch(N*k)`` drafts every slot up front, rows are
+budget-claimed atomically, and each chip trains its share as one
+vmapped pack (docs/trial_packing.md). Robustness is the headline
+(docs/mesh_sweep.md):
+
+  * **Elastic re-packing** — a chip lost mid-sweep (the supervisor's
+    ``scheduler.preempt`` chaos probe, or a runner thread dying) leaves
+    its trials RUNNING, never errored; the supervisor slices them off
+    the dead chip and re-assigns them round-robin to surviving chips,
+    where each resumes serially from its newest per-epoch packed
+    checkpoint (fresh rerun when none exists — both bit-match an
+    unfaulted serial run).
+  * **Collective-init retry** — mesh formation retries with exponential
+    backoff inside a bounded grace window (``RAFIKI_MESH_INIT_RETRIES``
+    / ``RAFIKI_MESH_INIT_BACKOFF_S`` / ``RAFIKI_MESH_FORM_GRACE_S``),
+    with the ``collective.init`` chaos site armed per attempt.
+  * **Bounded-grace degradation** — when the mesh cannot form inside
+    the grace window, the sweep degrades to single-chip mode instead of
+    failing: same trials, one chip, and a ``mesh_degraded`` event +
+    journal record so the downgrade is reconstructible after the fact.
+
+The per-chip worker is the ordinary :class:`TrainWorker` — every
+per-trial contract (store rows, scores, feedback, logs, params,
+events) is exactly the serial one; only placement and recovery are
+mesh-level concerns.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from rafiki_tpu import chaos, telemetry
+from rafiki_tpu.advisor import AdvisorService
+from rafiki_tpu.constants import (BudgetType, ServiceStatus, ServiceType,
+                                  TrainJobStatus, TrialStatus)
+from rafiki_tpu.model.base import load_model_class
+from rafiki_tpu.model.knobs import knob_config_signature
+from rafiki_tpu.obs.journal import journal as _journal
+from rafiki_tpu.obs.ledger import ledger
+from rafiki_tpu.parallel.mesh import local_devices
+from rafiki_tpu.scheduler.local import TrainJobResult
+from rafiki_tpu.store import MetaStore, ParamsStore
+from rafiki_tpu.utils.events import events
+from rafiki_tpu.worker.train import (InProcAdvisorHandle, PackAborted,
+                                     PackedTrialRunner, TrainWorker)
+
+
+class _ChipRunner:
+    """One chip's worker thread + task queue. Tasks are ``("pack",
+    rows)`` (train a claimed row set as one pack) or ``("resume",
+    trial_id)`` (serially resume a trial re-packed off a dead chip);
+    ``("stop", None)`` ends the thread. ``abort`` is the chip-loss
+    signal: the in-flight pack raises :class:`PackAborted` at its next
+    epoch boundary and the runner marks itself dead."""
+
+    def __init__(self, index: int, device: Any, worker: TrainWorker,
+                 pack: int, errors: List[str]):
+        self.index = index
+        self.device = device
+        self.worker = worker
+        self.runner = PackedTrialRunner(worker, pack)
+        self.tasks: "queue.Queue" = queue.Queue()
+        self.abort = threading.Event()
+        self.dead = False        # chip lost: no further tasks run here
+        self.reaped = False      # supervisor already re-packed its rows
+        self.busy = False
+        self._errors = errors
+        self.thread = threading.Thread(target=self._loop,
+                                       name=f"mesh-chip-{index}", daemon=True)
+
+    @property
+    def service_id(self) -> Optional[str]:
+        return self.worker.service_id
+
+    def idle(self) -> bool:
+        # unfinished_tasks increments at put() and only decrements at
+        # task_done() — unlike empty()+busy there is no window where an
+        # assigned-but-not-yet-started task reads as idle.
+        return self.tasks.unfinished_tasks == 0
+
+    def alive(self) -> bool:
+        return not self.dead and self.thread.is_alive()
+
+    def _loop(self) -> None:
+        # Leader/follower start skew: a delay-mode fault here staggers
+        # this chip's entry into the sweep (the mesh.skew chaos site).
+        chaos.hook("mesh.skew", key=f"chip{self.index}")
+        while True:
+            try:
+                kind, payload = self.tasks.get(timeout=0.05)
+            except queue.Empty:
+                if self.abort.is_set():
+                    self.dead = True
+                    return
+                continue
+            if kind == "stop":
+                self.tasks.task_done()
+                return
+            self.busy = True
+            try:
+                if kind == "pack":
+                    self.runner.run_assigned(payload, abort=self.abort)
+                else:  # "resume"
+                    self.worker.resume_trial(payload)
+            except PackAborted:
+                # Chip lost mid-pack: rows are still RUNNING; the
+                # supervisor re-packs them onto surviving chips.
+                self.dead = True
+                return  # the finally below still runs task_done()
+            except Exception as e:
+                # A task failure is contained (its trials are already
+                # marked errored by the worker); the chip lives on.
+                self._errors.append(f"chip {self.index}: {e!r}")
+            finally:
+                self.busy = False
+                if kind != "stop":
+                    self.tasks.task_done()
+
+
+class MeshSweepScheduler:
+    """Drives one train job as an elastic k-trials-per-chip × N-chip
+    sweep (docs/mesh_sweep.md). Blocking, in-process: one thread per
+    chip, a supervisor polling for chip loss and completion."""
+
+    def __init__(self, store: MetaStore, params_store: ParamsStore,
+                 advisor_service: Optional[AdvisorService] = None):
+        self.store = store
+        self.params_store = params_store
+        self.advisors = advisor_service or AdvisorService()
+
+    # -- mesh formation ------------------------------------------------------
+
+    def _form_mesh(self, want: int) -> "tuple[List[Any], bool]":
+        """Gather ``want`` devices, retrying collective initialization
+        with exponential backoff inside a bounded grace window. Returns
+        (devices, degraded): on exhaustion the sweep DEGRADES to
+        single-chip mode rather than failing — the trials all still
+        run, just without mesh parallelism."""
+        retries = int(os.environ.get("RAFIKI_MESH_INIT_RETRIES", "3"))
+        backoff = float(os.environ.get("RAFIKI_MESH_INIT_BACKOFF_S", "0.05"))
+        grace = float(os.environ.get("RAFIKI_MESH_FORM_GRACE_S", "30"))
+        deadline = time.monotonic() + grace
+        last: Optional[BaseException] = None
+        for attempt in range(retries + 1):
+            try:
+                # The collective.init chaos site is armed once per
+                # attempt (error mode = injected init failure).
+                chaos.hook("collective.init", key=f"attempt{attempt}")
+                devs = local_devices()
+                if len(devs) < want:
+                    raise RuntimeError(
+                        f"{len(devs)} device(s) visible, want {want}")
+                return devs[:want], False
+            except Exception as e:
+                last = e
+                if attempt >= retries or time.monotonic() >= deadline:
+                    break
+                telemetry.inc("mesh.init_retries")
+                events.emit("collective_init_retry", attempt=attempt,
+                            error=str(e))
+                time.sleep(max(0.0, min(backoff * (2 ** attempt),
+                                        deadline - time.monotonic())))
+        telemetry.inc("mesh.degraded_single_chip")
+        _journal.record("mesh", "degraded", want=want, error=str(last))
+        events.emit("mesh_degraded", want=want, error=str(last))
+        return local_devices()[:1], True
+
+    # -- the sweep -----------------------------------------------------------
+
+    def run_sweep(
+        self,
+        job_id: str,
+        chips: Optional[int] = None,
+        trials_per_chip: int = 2,
+        advisor_kind: str = "gp",
+        stop_event: Optional[threading.Event] = None,
+    ) -> TrainJobResult:
+        """Run a train job as one mesh sweep to budget exhaustion."""
+        t0 = time.time()
+        job = self.store.get_train_job(job_id)
+        if job is None:
+            raise KeyError(f"No train job {job_id!r}")
+        self.store.update_train_job_status(job_id, TrainJobStatus.RUNNING.value)
+        events.emit("train_job_started", job_id=job_id, app=job["app"],
+                    budget=job["budget"], scheduler="mesh")
+        stop_event = stop_event or threading.Event()
+
+        budget = dict(job["budget"])
+        chip_budget = budget.get("CHIP_COUNT") or budget.get("GPU_COUNT")
+        want = int(chips or chip_budget or 8)
+        devices, degraded = self._form_mesh(want)
+        k = max(1, int(trials_per_chip))
+
+        errors: List[str] = []
+        subs = self.store.get_sub_train_jobs(job_id)
+        if not subs:
+            raise ValueError(f"Train job {job_id} has no sub jobs (no models attached)")
+
+        for sub in subs:
+            if stop_event.is_set():
+                self.store.update_sub_train_job(
+                    sub["id"], status=TrainJobStatus.STOPPED.value)
+                continue
+            model_row = self.store.get_model(sub["model_id"])
+            try:
+                model_cls = load_model_class(model_row["model_file"],
+                                             model_row["model_class"])
+            except Exception as e:
+                self.store.update_sub_train_job(
+                    sub["id"], status=TrainJobStatus.ERRORED.value)
+                errors.append(f"model {model_row['name']}: {e}")
+                continue
+            advisor_id = self.advisors.create_advisor(
+                model_cls.get_knob_config(), kind=advisor_kind,
+                advisor_id=sub.get("advisor_id") or None)
+            self.store.update_sub_train_job(sub["id"], advisor_id=advisor_id,
+                                            status=TrainJobStatus.RUNNING.value)
+            handle = InProcAdvisorHandle(self.advisors, advisor_id)
+
+            self._run_sub(job, sub, model_cls, handle, devices, k,
+                          budget, errors, stop_event)
+
+            trials = self.store.get_trials_of_sub_train_job(sub["id"])
+            if stop_event.is_set():
+                sub_status = TrainJobStatus.STOPPED.value
+            elif trials and all(t["status"] == TrialStatus.ERRORED.value
+                                for t in trials):
+                sub_status = TrainJobStatus.ERRORED.value
+            else:
+                sub_status = TrainJobStatus.COMPLETED.value
+            self.store.update_sub_train_job(sub["id"], status=sub_status)
+            self.advisors.delete_advisor(advisor_id)
+
+        subs_after = self.store.get_sub_train_jobs(job_id)
+        if stop_event.is_set():
+            status = TrainJobStatus.STOPPED.value
+        elif subs_after and all(s["status"] == TrainJobStatus.ERRORED.value
+                                for s in subs_after):
+            status = TrainJobStatus.ERRORED.value
+        else:
+            status = TrainJobStatus.COMPLETED.value
+        self.store.update_train_job_status(job_id, status)
+        telemetry.inc("scheduler.train_jobs_finished")
+        telemetry.observe("scheduler.train_job_s", time.time() - t0)
+        events.emit("train_job_finished", job_id=job_id, status=status,
+                    duration_s=round(time.time() - t0, 3),
+                    degraded=degraded)
+        return TrainJobResult(
+            job_id=job_id,
+            status=status,
+            trials=self.store.get_trials_of_train_job(job_id),
+            best_trials=self.store.get_best_trials_of_train_job(job_id, limit=2),
+            duration_s=time.time() - t0,
+            errors=errors,
+        )
+
+    def _run_sub(self, job: dict, sub: dict, model_cls: type, handle,
+                 devices: List[Any], k: int, budget: Dict[str, Any],
+                 errors: List[str], stop_event: threading.Event) -> None:
+        """One sub-job's sweep: draft, claim, distribute, supervise."""
+        job_id = job["id"]
+        n_chips = len(devices)
+        max_trials = budget.get(BudgetType.MODEL_TRIAL_COUNT.value)
+        budget_max = int(max_trials) if max_trials is not None else None
+        n_slots = n_chips * k
+        if budget_max is not None:
+            n_slots = min(n_slots, budget_max)
+
+        # ONE batched draft for the whole mesh — the paper's per-GPU
+        # propose loop collapses into a single call.
+        with telemetry.span("mesh.advisor_propose", job_id=job_id, n=n_slots):
+            batch = getattr(handle, "propose_batch", None)
+            proposals = (batch(n_slots) if batch is not None
+                         else [handle.propose() for _ in range(n_slots)])
+
+        # Services + workers, one per chip. Sync persistence: the
+        # supervisor reads row statuses for completion tracking, so
+        # scores must be durable when a pack returns.
+        knob_config = model_cls.get_knob_config()
+        runners: List[_ChipRunner] = []
+        for i, dev in enumerate(devices):
+            service = self.store.create_service(
+                ServiceType.TRAIN_WORKER.value, job_id=job_id,
+                worker_index=i, devices=[str(dev)])
+            self.store.update_service(service["id"],
+                                      status=ServiceStatus.RUNNING.value)
+            worker = TrainWorker(
+                self.store, self.params_store, sub["id"], model_cls, handle,
+                job["train_dataset_uri"], job["val_dataset_uri"], budget,
+                worker_id=f"{job_id[:8]}-mesh-c{i}", devices=[dev],
+                job_created_at=job["created_at"], service_id=service["id"],
+                stop_event=stop_event, async_persist=False,
+            )
+            runners.append(_ChipRunner(i, dev, worker, k, errors))
+
+        # Claim every row up front (atomic budget slots), bucketed by
+        # packing key — only same-key rows may share a pack — then
+        # round-robin each bucket across chips.
+        buckets: Dict[str, List[tuple]] = {}
+        order: List[str] = []
+        for kn in proposals:
+            try:
+                m = model_cls(**kn)
+                key = repr(m.packing_key(m._prepared_dataset(
+                    job["train_dataset_uri"])))
+            except Exception:
+                key = f"unpackable:{id(kn)}"  # its own singleton pack
+            trial = self.store.create_trial(
+                sub["id"], model_cls.__name__, kn,
+                shape_sig=knob_config_signature(knob_config, kn),
+                budget_max=budget_max)
+            if trial is None:
+                break  # budget drained under us
+            if key not in buckets:
+                order.append(key)
+                buckets[key] = []
+            buckets[key].append((trial["id"], kn))
+        assign: List[List[List[tuple]]] = [[[] for _ in order]
+                                           for _ in runners]
+        for b, key in enumerate(order):
+            for j, row in enumerate(buckets[key]):
+                assign[j % n_chips][b].append(row)
+        for r, per_bucket in zip(runners, assign):
+            for rows in per_bucket:
+                if rows:
+                    # Bind the rows to their chip's service so a later
+                    # chip loss can find exactly this chip's orphans.
+                    for tid, _kn in rows:
+                        self.store.mark_trial_as_running(
+                            tid, service_id=r.service_id,
+                            worker_id=r.worker.worker_id)
+                    r.tasks.put(("pack", rows))
+        _journal.record("mesh", "sweep_started", job_id=job_id,
+                        chips=n_chips, trials_per_chip=k,
+                        n_trials=sum(len(v) for v in buckets.values()))
+        for r in runners:
+            r.thread.start()
+
+        self._supervise(job_id, sub["id"], runners, stop_event)
+
+        for r in runners:
+            if r.worker._saver is not None:
+                r.worker._saver.close()
+            self.store.update_service(r.service_id,
+                                      status=ServiceStatus.STOPPED.value)
+
+    def _supervise(self, job_id: str, sub_id: str,
+                   runners: List[_ChipRunner],
+                   stop_event: threading.Event) -> None:
+        """Poll for chip loss (the ``scheduler.preempt`` chaos probe —
+        the same site the process scheduler consults, keyed
+        ``chip<i>``), re-pack dead chips' trials onto survivors, and
+        stop every runner once the sweep is drained."""
+        lost_at: Dict[int, float] = {}
+        rr = 0  # round-robin cursor over survivors for re-packed rows
+        while True:
+            for r in runners:
+                if not r.alive():
+                    continue
+                decision = chaos.decide("scheduler.preempt",
+                                        key=f"chip{r.index}")
+                if decision is not None and decision.mode in (
+                        "kill", "term", "preempt"):
+                    # Chip loss: the in-flight pack aborts at its next
+                    # epoch boundary (checkpoints durable first).
+                    r.abort.set()
+                    lost_at[r.index] = time.monotonic()
+
+            for r in runners:
+                if r.reaped or r.alive():
+                    continue
+                r.reaped = True
+                r.dead = True
+                telemetry.inc("mesh.chips_lost")
+                events.emit("mesh_chip_lost", job_id=job_id,
+                            chip=r.index, worker_id=r.worker.worker_id)
+                _journal.record("mesh", "chip_lost", job_id=job_id,
+                                chip=r.index)
+                orphans = [t["id"] for t in
+                           self.store.get_trials_of_sub_train_job(sub_id)
+                           if t["status"] == TrialStatus.RUNNING.value
+                           and t.get("service_id") == r.service_id]
+                survivors = [s for s in runners if s.alive()]
+                if not survivors:
+                    for tid in orphans:
+                        self.store.mark_trial_as_errored(
+                            tid, "mesh sweep lost every chip")
+                    _journal.record("mesh", "repack_failed", job_id=job_id,
+                                    chip=r.index, orphans=orphans)
+                    continue
+                for tid in orphans:
+                    target = survivors[rr % len(survivors)]
+                    rr += 1
+                    # Re-bind BEFORE enqueueing: if the target chip
+                    # dies with this resume still queued, the next
+                    # reap's orphan query must find the row under the
+                    # target's service, not the already-reaped one's.
+                    self.store.mark_trial_as_running(
+                        tid, service_id=target.service_id,
+                        worker_id=target.worker.worker_id)
+                    target.tasks.put(("resume", tid))
+                _journal.record("mesh", "repack", job_id=job_id,
+                                chip=r.index, moved=orphans,
+                                survivors=[s.index for s in survivors])
+                # Downtime: wall-clock from the loss signal to re-pack,
+                # charged to the sweep's mesh entity so the goodput
+                # report shows recovery cost (docs/observability.md).
+                t_lost = lost_at.get(r.index)
+                if t_lost is not None:
+                    # lint: disable=RF007 — downtime_s ledger charge, not a span
+                    ledger.add("downtime_s", time.monotonic() - t_lost,
+                               entity=f"mesh:{job_id}")
+
+            live = [r for r in runners if r.alive()]
+            pending_reap = [r for r in runners
+                            if not r.alive() and not r.reaped]
+            if stop_event.is_set():
+                break
+            if not pending_reap and (not live or all(r.idle() for r in live)):
+                break
+            time.sleep(0.02)
+
+        for r in runners:
+            if r.alive():
+                r.tasks.put(("stop", None))
+        for r in runners:
+            r.thread.join(timeout=30.0)

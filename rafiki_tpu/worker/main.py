@@ -27,6 +27,41 @@ from __future__ import annotations
 
 import os
 import sys
+import time
+
+
+def initialize_collective(initialize, coordinator: str, num_processes: int,
+                          process_id: int) -> None:
+    """Join the distributed cluster with retry + exponential backoff.
+
+    Collective initialization is the flakiest moment of a multihost
+    job: a follower that races the coordinator's bind, or a transient
+    DCN hiccup, fails ``jax.distributed.initialize`` even though the
+    pod is healthy. Bounded retries (``RAFIKI_COLLECTIVE_INIT_RETRIES``,
+    backoff ``RAFIKI_COLLECTIVE_INIT_BACKOFF_S`` doubling per attempt)
+    absorb that; exhaustion re-raises the last error so the scheduler's
+    restart-with-backoff path takes over. The ``collective.init`` chaos
+    site is armed once per attempt (error mode = injected init
+    failure), keyed ``p<process_id>`` (docs/chaos.md).
+    """
+    from rafiki_tpu import chaos
+    from rafiki_tpu.utils.events import events
+
+    retries = int(os.environ.get("RAFIKI_COLLECTIVE_INIT_RETRIES", "3"))
+    backoff = float(os.environ.get("RAFIKI_COLLECTIVE_INIT_BACKOFF_S", "0.5"))
+    for attempt in range(retries + 1):
+        try:
+            chaos.hook("collective.init", key=f"p{process_id}")
+            initialize(coordinator_address=coordinator,
+                       num_processes=num_processes,
+                       process_id=process_id)
+            return
+        except Exception as e:
+            if attempt >= retries:
+                raise
+            events.emit("collective_init_retry", process_id=process_id,
+                        attempt=attempt, error=str(e))
+            time.sleep(backoff * (2 ** attempt))
 
 
 def main() -> int:
@@ -81,10 +116,16 @@ def main() -> int:
     # mirror its trials compute-for-compute (worker/follower.py).
     coordinator = os.environ.get("RAFIKI_COORDINATOR_ADDRESS")
     if coordinator:
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=int(os.environ["RAFIKI_NUM_PROCESSES"]),
-            process_id=int(os.environ["RAFIKI_PROCESS_ID"]))
+        from rafiki_tpu import chaos
+
+        process_id = int(os.environ["RAFIKI_PROCESS_ID"])
+        # Start-skew site: a delay-mode fault here staggers this
+        # process's arrival at the collective barrier (leader/follower
+        # skew — docs/chaos.md).
+        chaos.hook("mesh.skew", key=f"p{process_id}")
+        initialize_collective(
+            jax.distributed.initialize, coordinator,
+            int(os.environ["RAFIKI_NUM_PROCESSES"]), process_id)
 
     jax.devices()  # force backend init under the watchdog
     watchdog.cancel()

@@ -62,6 +62,14 @@ class InProcAdvisorHandle:
         self._svc.feedback(self._id, score, knobs)
 
 
+class PackAborted(RuntimeError):
+    """A pack was torn down mid-train by its supervisor (chip lost,
+    mesh preempt) rather than by a trial failure. The rows stay
+    RUNNING — deliberately NOT marked errored — so the mesh scheduler
+    can re-pack them onto surviving chips, where each resumes from its
+    newest per-epoch packed checkpoint (docs/mesh_sweep.md)."""
+
+
 class TrainWorker:
     def __init__(
         self,
@@ -537,7 +545,28 @@ class PackedTrialRunner:
             # reusing the already-claimed row via the resume path.
             out = w.run_trial(rows[0][1], resume_trial_id=rows[0][0])
             return (1 if out is not None else 0), drained
+        return self._train_rows(rows, budget_max, drained)
 
+    def run_assigned(self, rows: "List[tuple[str, Knobs]]",
+                     budget_max: Optional[int] = None,
+                     abort=None) -> int:
+        """Train an externally-claimed set of trial rows as one pack
+        (the mesh scheduler's entry point — it creates rows up front
+        and assigns them chip by chip). ``abort`` is a threading.Event:
+        when set, the pack raises :class:`PackAborted` at the next
+        epoch boundary — AFTER that epoch's checkpoints are durable —
+        leaving every row RUNNING for re-packing. Returns the number
+        of rows carried to completion (success or errored)."""
+        if not rows:
+            return 0
+        n, _ = self._train_rows(list(rows), budget_max, False, abort=abort)
+        return n
+
+    def _train_rows(self, rows: "List[tuple[str, Knobs]]",
+                    budget_max: Optional[int], drained: bool,
+                    abort=None) -> "tuple[int, bool]":
+        w = self.w
+        knob_config = w.model_class.get_knob_config()
         k = len(rows)
         telemetry.observe("trial_pack.size", float(k))
         telemetry.observe("trial_pack.fill_ratio", k / float(self.pack))
@@ -559,11 +588,60 @@ class PackedTrialRunner:
                     models = [w.model_class(**kn) for _, kn in rows]
 
                 def heartbeat(_epoch: int) -> None:
+                    # Abort lands at the epoch boundary AFTER the
+                    # checkpoint sink ran, so the newest epoch of every
+                    # member is durable before the pack unwinds.
+                    if abort is not None and abort.is_set():
+                        raise PackAborted(
+                            f"pack on {w.worker_id} aborted at epoch boundary")
                     if w.service_id is not None:
                         now = time.monotonic()
                         if now - w._last_heartbeat >= w.heartbeat_min_interval_s:
                             w._last_heartbeat = now
                             w.store.update_service(w.service_id, heartbeat=True)
+
+                def on_evict(mi: int, epoch: int, reason: str) -> None:
+                    events.emit("pack_member_evicted", trial_id=rows[mi][0],
+                                epoch=epoch, reason=reason,
+                                worker_id=w.worker_id)
+
+                def backfill(n: int) -> List[BaseModel]:
+                    """Fill freed pack slots with freshly proposed
+                    trials mid-pack. Proposals whose packing_key differs
+                    from the live pack's are dropped (they'd need their
+                    own program; the next round picks them up via the
+                    normal draft path) BEFORE any row is claimed."""
+                    nonlocal drained
+                    if drained or w.advisor is None:
+                        return []
+                    pack_key = repr(models[0].packing_key(
+                        models[0]._prepared_dataset(w.train_uri)))
+                    out: List[BaseModel] = []
+                    for _ in range(n):
+                        try:
+                            kn = w.advisor.propose()
+                            m2 = w.model_class(**kn)
+                            if repr(m2.packing_key(
+                                    m2._prepared_dataset(w.train_uri))) != pack_key:
+                                telemetry.inc("trial_pack.backfill_key_mismatch")
+                                continue
+                        except Exception:
+                            continue
+                        trial = w.store.create_trial(
+                            w.sub_id, w.model_class.__name__, kn,
+                            worker_id=w.worker_id,
+                            shape_sig=knob_config_signature(knob_config, kn),
+                            service_id=w.service_id, budget_max=budget_max)
+                        if trial is None:
+                            drained = True
+                            break
+                        rows.append((trial["id"], kn))
+                        events.emit("trial_started", trial_id=trial["id"],
+                                    sub_job_id=w.sub_id,
+                                    model=w.model_class.__name__,
+                                    worker_id=w.worker_id, knobs=kn)
+                        out.append(m2)
+                    return out
 
                 # Per-epoch checkpoints for the WHOLE pack: each trial
                 # gets its own serial-format checkpoint sliced out of
@@ -586,9 +664,20 @@ class PackedTrialRunner:
                 with telemetry.span("trial_pack.train"):
                     histories = w.model_class.train_packed(
                         models, w.train_uri, on_epoch=heartbeat,
-                        checkpoint_sink=ckpt_sink)
+                        checkpoint_sink=ckpt_sink,
+                        backfill=backfill, on_evict=on_evict)
                 with telemetry.span("trial_pack.evaluate"):
                     scores = w.model_class.evaluate_packed(models, w.val_uri)
+        except PackAborted:
+            # Supervisor-driven teardown: rows STAY RUNNING (the mesh
+            # re-packs them onto surviving chips), device state is
+            # released, and the abort propagates to the caller.
+            for m in models:
+                try:
+                    m.destroy()
+                except Exception:
+                    pass
+            raise
         except Exception:
             err = traceback.format_exc()
             for tid, kn in rows:
@@ -607,7 +696,7 @@ class PackedTrialRunner:
                     m.destroy()
                 except Exception:
                     pass
-            return k, drained
+            return len(rows), drained
 
         # Completed packs supersede their mid-trial checkpoints the same
         # way serial trials do (_persist deletes them per trial below).
@@ -630,7 +719,7 @@ class PackedTrialRunner:
             else:
                 w._persist(tid, models[i], score)
         telemetry.inc("worker.packed_rounds")
-        return k, drained
+        return len(rows), drained
 
     def _save_pack_checkpoints(self, rows, epoch: int, make_blobs) -> None:
         """Write one epoch's per-trial checkpoints for the pack, with
@@ -650,15 +739,19 @@ class PackedTrialRunner:
             # lint: disable=RF007 — checkpoint_s ledger charge, not a span
             ledger.add("checkpoint_s", time.monotonic() - t0)
             return
-        for (tid, _kn), blob in zip(rows, blobs):
+        # make_blobs() yields (model_index, member_epoch, blob) — each
+        # member's checkpoint is filed under its OWN epoch counter
+        # (evicted/backfilled members drift from the pack round index).
+        for mi, member_epoch, blob in blobs:
+            tid = rows[mi][0]
             try:
-                w.params_store.save_checkpoint(tid, epoch, blob)
-                events.emit("checkpoint_written", trial_id=tid, epoch=epoch,
-                            worker_id=w.worker_id)
+                w.params_store.save_checkpoint(tid, member_epoch, blob)
+                events.emit("checkpoint_written", trial_id=tid,
+                            epoch=member_epoch, worker_id=w.worker_id)
             except Exception:
                 telemetry.inc("worker.checkpoint_write_failed")
                 events.emit("checkpoint_write_failed", trial_id=tid,
-                            epoch=epoch, worker_id=w.worker_id,
+                            epoch=member_epoch, worker_id=w.worker_id,
                             error=traceback.format_exc(limit=3))
         # Charged to the bound pack entity (the sink runs inside it).
         # lint: disable=RF007 — checkpoint_s ledger charge, not a span

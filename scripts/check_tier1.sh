@@ -32,6 +32,12 @@ env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py > /tmp/_chaos_smoke.json \
 # /metrics?format=prom must line-parse (docs/observability.md). ~6s.
 env JAX_PLATFORMS=cpu python scripts/obs_smoke.py > /tmp/_obs_smoke.json \
   || { echo "TIER1 OBS SMOKE FAILED (see /tmp/_obs_smoke.json)"; exit 1; }
+# Mesh-sweep smoke: a 2-virtual-chip elastic sweep with one injected
+# chip loss (docs/mesh_sweep.md) — re-packs onto the survivor, every
+# trial scores, resumed params bit-match serial. ~10s; a vacuous pass
+# (no fault fired) also fails the gate.
+env JAX_PLATFORMS=cpu python scripts/mesh_smoke.py > /tmp/_mesh_smoke.json \
+  || { echo "TIER1 MESH SMOKE FAILED (see /tmp/_mesh_smoke.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

@@ -229,6 +229,15 @@ def test_rf003_quiet_on_insert_idiom_and_get(tmp_path):
     assert "RF003" not in _ids(r)
 
 
+def test_rf003_current_bus_queues_is_clean():
+    """The live bus keeps the read-side fix: heartbeat/get_workers use
+    ``.get(job_id, ...)`` instead of defaultdict subscripts, so probing
+    rotating job ids cannot leak empty registry entries."""
+    live = os.path.join(REPO, "rafiki_tpu", "bus", "queues.py")
+    r = analyze_paths([live], select=["RF003"])
+    assert r.unsuppressed == []
+
+
 # ---------------------------------------------------------------------------
 # RF004 unguarded-shared-mutation
 # ---------------------------------------------------------------------------

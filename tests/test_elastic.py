@@ -185,3 +185,138 @@ def test_multihost_group_member_sigkill_respawns_group(env, crash_process):
     # Group teardown is direct process supervision; it must not have
     # waited out a multi-minute collective transport timeout.
     assert wall < 180, f"group teardown took {wall:.0f}s — timeout-bound?"
+
+
+# ---------------------------------------------------------------------------
+# Mesh sweep elasticity (docs/mesh_sweep.md): k packed trials per chip
+# × N chips, re-packed onto survivors when a chip is lost, degraded to
+# single-chip mode when the mesh cannot form. CPU mesh: the conftest
+# pins 8 virtual host devices.
+# ---------------------------------------------------------------------------
+
+# ChaosFF (3 epochs, lr the only tuned knob → ONE packing bucket, so
+# assignment splits deterministically across chips) and EvictFF (its
+# early-stop variant) come from the chaos catalog — same fixtures the
+# scenario runner exercises.
+from rafiki_tpu.chaos.scenarios import EVICT_SOURCE  # noqa: E402
+from rafiki_tpu.chaos.scenarios import FF_SOURCE as CHAOS_FF_SOURCE  # noqa: E402
+
+
+def test_mesh_sweep_packs_trials_across_chips(env):
+    from rafiki_tpu.scheduler import MeshSweepScheduler
+
+    store, params, _ = env
+    model = store.create_model("chaosff", "IMAGE_CLASSIFICATION", None,
+                               CHAOS_FF_SOURCE, "ChaosFF")
+    job = _job(store, model, {"MODEL_TRIAL_COUNT": 4})
+    result = MeshSweepScheduler(store, params).run_sweep(
+        job["id"], chips=2, trials_per_chip=2, advisor_kind="random")
+    assert result.status == "COMPLETED", result.errors
+    assert len(result.trials) == 4
+    assert all(t["status"] == "COMPLETED" for t in result.trials)
+    assert all(t.get("score") is not None for t in result.trials)
+    # One packing bucket round-robins across both chips: each trained 2.
+    workers = sorted({t["worker_id"] for t in result.trials})
+    assert workers == [f"{job['id'][:8]}-mesh-c0", f"{job['id'][:8]}-mesh-c1"]
+
+
+def test_mesh_chip_killed_mid_sweep_repacks_onto_survivor(env, monkeypatch):
+    from rafiki_tpu import telemetry
+    from rafiki_tpu.chaos import FaultPlane, install, uninstall
+    from rafiki_tpu.scheduler import MeshSweepScheduler
+
+    store, params, _ = env
+    monkeypatch.setenv("RAFIKI_CHECKPOINT_EVERY", "1")
+    model = store.create_model("chaosff", "IMAGE_CLASSIFICATION", None,
+                               CHAOS_FF_SOURCE, "ChaosFF")
+    job = _job(store, model, {"MODEL_TRIAL_COUNT": 4})
+    telemetry.reset()
+    install(FaultPlane.from_spec(
+        "seed=11;scheduler.preempt:kill:after=2:times=1:match=chip1"))
+    try:
+        result = MeshSweepScheduler(store, params).run_sweep(
+            job["id"], chips=2, trials_per_chip=2, advisor_kind="random")
+    finally:
+        uninstall()
+    assert result.status == "COMPLETED", result.errors
+    assert len(result.trials) == 4, "chip loss lost or duplicated rows"
+    assert all(t["status"] == "COMPLETED" for t in result.trials)
+    assert all(t.get("score") is not None for t in result.trials), \
+        "a surviving trial finished without a recorded score"
+    assert telemetry.get_counter("mesh.chips_lost") >= 1.0
+    # The re-packed rows finished under the surviving chip's worker.
+    assert any((t["worker_id"] or "").endswith("-mesh-c0")
+               for t in result.trials)
+
+
+def test_pack_straggler_evicted_and_backfilled(env):
+    from rafiki_tpu import telemetry
+    from rafiki_tpu.advisor import AdvisorService
+    from rafiki_tpu.model.base import load_model_class
+    from rafiki_tpu.model.knobs import knob_config_signature
+    from rafiki_tpu.worker.train import (InProcAdvisorHandle,
+                                         PackedTrialRunner, TrainWorker)
+
+    store, params, _ = env
+    telemetry.reset()
+    model = store.create_model("evictff", "IMAGE_CLASSIFICATION", None,
+                               EVICT_SOURCE, "EvictFF")
+    job = _job(store, model, {"MODEL_TRIAL_COUNT": 3})
+    sub = store.get_sub_train_jobs(job["id"])[0]
+    cls = load_model_class(EVICT_SOURCE, "EvictFF")
+    advisors = AdvisorService()
+    advisor_id = advisors.create_advisor(cls.get_knob_config(), kind="random")
+    worker = TrainWorker(
+        store, params, sub["id"], cls,
+        InProcAdvisorHandle(advisors, advisor_id), TRAIN, VAL,
+        {"MODEL_TRIAL_COUNT": 3}, worker_id="evict-w0", async_persist=False)
+    knob_config = cls.get_knob_config()
+    base = {"hidden_units": 16, "batch_size": 32, "epochs": 3}
+    rows = []
+    # lr >= 0.02 trips EvictFF.should_stop_early at epoch 0: member 0
+    # is the straggler, member 1 trains its full 3-epoch budget.
+    for kn in (dict(base, learning_rate=0.025),
+               dict(base, learning_rate=0.005)):
+        trial = store.create_trial(
+            sub["id"], "EvictFF", kn,
+            shape_sig=knob_config_signature(knob_config, kn), budget_max=3)
+        rows.append((trial["id"], kn))
+    n = PackedTrialRunner(worker, 2).run_assigned(rows, budget_max=3)
+    assert n == 3, "the freed slot was not backfilled"
+    trials = store.get_trials_of_train_job(job["id"])
+    assert len(trials) == 3
+    assert all(t["status"] == "COMPLETED" for t in trials)
+    assert all(t.get("score") is not None for t in trials)
+    assert telemetry.get_counter("trial_pack.evictions") >= 1.0, \
+        "the straggler was never evicted from the stacked state"
+    assert telemetry.get_counter("trial_pack.backfills") >= 1.0, \
+        "no freshly proposed trial was admitted mid-pack"
+
+
+def test_mesh_degrades_to_single_chip(env, monkeypatch):
+    from rafiki_tpu import telemetry
+    from rafiki_tpu.chaos import FaultPlane, install, uninstall
+    from rafiki_tpu.scheduler import MeshSweepScheduler
+
+    store, params, _ = env
+    monkeypatch.setenv("RAFIKI_MESH_INIT_RETRIES", "2")
+    monkeypatch.setenv("RAFIKI_MESH_INIT_BACKOFF_S", "0.01")
+    monkeypatch.setenv("RAFIKI_MESH_FORM_GRACE_S", "5")
+    model = store.create_model("chaosff", "IMAGE_CLASSIFICATION", None,
+                               CHAOS_FF_SOURCE, "ChaosFF")
+    job = _job(store, model, {"MODEL_TRIAL_COUNT": 2})
+    telemetry.reset()
+    install(FaultPlane.from_spec("seed=17;collective.init:error:times=8"))
+    try:
+        result = MeshSweepScheduler(store, params).run_sweep(
+            job["id"], chips=2, trials_per_chip=2, advisor_kind="random")
+    finally:
+        uninstall()
+    assert result.status == "COMPLETED", result.errors
+    assert len(result.trials) == 2
+    assert all(t["status"] == "COMPLETED" for t in result.trials)
+    assert telemetry.get_counter("mesh.degraded_single_chip") >= 1.0
+    assert telemetry.get_counter("mesh.init_retries") >= 2.0
+    # Everything ran on the single surviving chip's worker.
+    assert {t["worker_id"] for t in result.trials} == \
+        {f"{job['id'][:8]}-mesh-c0"}

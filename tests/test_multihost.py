@@ -154,3 +154,65 @@ def test_multihost_stop_event(env):
     th.join(timeout=90)
     assert not th.is_alive()
     assert out["result"].status == "STOPPED"
+
+
+# ---------------------------------------------------------------------------
+# Collective-init retry (worker/main.py initialize_collective): the
+# flakiest moment of a multihost job gets bounded retries with backoff.
+# Driven with a fake initialize fn — no real jax.distributed cluster.
+# ---------------------------------------------------------------------------
+
+
+def test_collective_init_retries_transient_failure(monkeypatch):
+    from rafiki_tpu.worker.main import initialize_collective
+
+    monkeypatch.setenv("RAFIKI_COLLECTIVE_INIT_RETRIES", "3")
+    monkeypatch.setenv("RAFIKI_COLLECTIVE_INIT_BACKOFF_S", "0.01")
+    calls = []
+
+    def flaky(coordinator_address, num_processes, process_id):
+        calls.append((coordinator_address, num_processes, process_id))
+        if len(calls) == 1:
+            raise RuntimeError("transient barrier race")
+
+    initialize_collective(flaky, "127.0.0.1:9999", 2, 1)
+    assert len(calls) == 2, "the failed attempt was not retried"
+    assert calls[-1] == ("127.0.0.1:9999", 2, 1)
+
+
+def test_collective_init_exhaustion_reraises(monkeypatch):
+    from rafiki_tpu.worker.main import initialize_collective
+
+    monkeypatch.setenv("RAFIKI_COLLECTIVE_INIT_RETRIES", "2")
+    monkeypatch.setenv("RAFIKI_COLLECTIVE_INIT_BACKOFF_S", "0.01")
+    calls = []
+
+    def dead(coordinator_address, num_processes, process_id):
+        calls.append(1)
+        raise RuntimeError("coordinator unreachable")
+
+    with pytest.raises(RuntimeError, match="coordinator unreachable"):
+        initialize_collective(dead, "127.0.0.1:9999", 2, 0)
+    assert len(calls) == 3, "retries + the final attempt"
+
+
+def test_collective_init_chaos_fault_absorbed_by_retry(monkeypatch):
+    """An injected collective.init error (the chaos site armed per
+    attempt) must be absorbed exactly like a real init failure: the
+    faulted attempt never reaches the initialize fn, the retry does."""
+    from rafiki_tpu.chaos import FaultPlane, install, uninstall
+    from rafiki_tpu.worker.main import initialize_collective
+
+    monkeypatch.setenv("RAFIKI_COLLECTIVE_INIT_RETRIES", "3")
+    monkeypatch.setenv("RAFIKI_COLLECTIVE_INIT_BACKOFF_S", "0.01")
+    calls = []
+
+    def ok(coordinator_address, num_processes, process_id):
+        calls.append(1)
+
+    install(FaultPlane.from_spec("seed=5;collective.init:error:times=1"))
+    try:
+        initialize_collective(ok, "127.0.0.1:9999", 2, 0)
+    finally:
+        uninstall()
+    assert len(calls) == 1, "the injected-fault attempt leaked through"

@@ -184,3 +184,54 @@ def test_sigkilled_inference_worker_degrades_to_k_minus_1(served):
     # vectors over the 5 synthetic classes.
     assert len(out) == len(queries)
     assert all(len(np.asarray(o)) == 5 for o in out)
+
+
+def _bus_squatter(bus, job, worker_id, beat_s):
+    """Spawn target: register on the bus and heartbeat until killed —
+    the minimal process whose SIGKILL leaves a corpse registration."""
+    bus.add_worker(job, worker_id)
+    while True:
+        bus.heartbeat(job, worker_id)
+        time.sleep(beat_s)
+
+
+def test_sigkilled_worker_corpse_reaped_by_get_workers_janitor():
+    """Janitor regression (bus/queues.py): a SIGKILLed worker never
+    runs remove_worker, so its registration, lease timestamp and
+    pending-query queue persist. Once its lease is REAP_FACTOR×TTL old,
+    an ordinary ``get_workers(ttl)`` read must reap all three — no
+    explicit reap_stale call anywhere."""
+    ttl = 0.3
+    ctx = mp.get_context("spawn")
+    bus = make_mp_bus(ctx.Manager())
+    job = "reap-job"
+    p = ctx.Process(target=_bus_squatter, args=(bus, job, "corpse", 0.05),
+                    daemon=True)
+    p.start()
+    deadline = time.monotonic() + 60
+    while "corpse" not in bus.get_workers(job):
+        assert p.is_alive(), f"squatter died (exit {p.exitcode})"
+        assert time.monotonic() < deadline, "squatter never registered"
+        time.sleep(0.02)
+
+    # A pending fan-out the corpse will never pop: the janitor must
+    # delete this queue too, or corpse queues grow under churn.
+    bus.add_query("corpse", "q-leak", [1.0])
+    assert bus.queue_depth("corpse") == 1
+
+    os.kill(p.pid, signal.SIGKILL)
+    p.join(10)
+    assert not p.is_alive()
+
+    # Only lease-filtered reads run the janitor; the unfiltered read
+    # shows whether the REGISTRATION still exists (vs merely being
+    # hidden by the TTL filter).
+    deadline = time.monotonic() + 30
+    while "corpse" in bus.get_workers(job):
+        bus.get_workers(job, max_age_s=ttl)  # the observing read
+        assert time.monotonic() < deadline, \
+            "janitor never reaped the SIGKILLed worker's registration"
+        time.sleep(0.05)
+    assert bus.queue_depth("corpse") == 0, "corpse queue outlived the reap"
+    assert f"{job}|corpse" not in dict(bus._worker_ts), \
+        "corpse lease timestamp outlived the reap"
