@@ -62,10 +62,12 @@ class _ChipRunner:
     epoch boundary and the runner marks itself dead."""
 
     def __init__(self, index: int, device: Any, worker: TrainWorker,
-                 pack: int, errors: List[str]):
+                 pack: int, errors: List[str],
+                 budget_max: Optional[int] = None):
         self.index = index
         self.device = device
         self.worker = worker
+        self.budget_max = budget_max
         self.runner = PackedTrialRunner(worker, pack)
         self.tasks: "queue.Queue" = queue.Queue()
         self.abort = threading.Event()
@@ -104,10 +106,23 @@ class _ChipRunner:
             if kind == "stop":
                 self.tasks.task_done()
                 return
+            if self.abort.is_set():
+                # Lost/stopping chip: don't START queued work — its rows
+                # stay RUNNING bound to this chip's service, so the
+                # supervisor's reap finds and re-packs them.
+                self.dead = True
+                self.tasks.task_done()
+                return
             self.busy = True
             try:
                 if kind == "pack":
-                    self.runner.run_assigned(payload, abort=self.abort)
+                    # budget_max keeps the mid-pack backfill closure on
+                    # the atomic slot-claim path: without it, backfilled
+                    # trials bypass MODEL_TRIAL_COUNT and the pack never
+                    # drains.
+                    self.runner.run_assigned(payload,
+                                             budget_max=self.budget_max,
+                                             abort=self.abort)
                 else:  # "resume"
                     self.worker.resume_trial(payload)
             except PackAborted:
@@ -171,7 +186,19 @@ class MeshSweepScheduler:
         telemetry.inc("mesh.degraded_single_chip")
         _journal.record("mesh", "degraded", want=want, error=str(last))
         events.emit("mesh_degraded", want=want, error=str(last))
-        return local_devices()[:1], True
+        # The degraded fallback may itself fail (device runtime down,
+        # zero devices visible) — return [] and let run_sweep fail the
+        # job cleanly instead of propagating with the job left RUNNING.
+        try:
+            devs = local_devices()[:1]
+        except Exception as e:
+            last = e
+            devs = []
+        if not devs:
+            _journal.record("mesh", "no_devices", want=want,
+                            error=str(last))
+            events.emit("mesh_no_devices", want=want, error=str(last))
+        return devs, True
 
     # -- the sweep -----------------------------------------------------------
 
@@ -197,6 +224,24 @@ class MeshSweepScheduler:
         chip_budget = budget.get("CHIP_COUNT") or budget.get("GPU_COUNT")
         want = int(chips or chip_budget or 8)
         devices, degraded = self._form_mesh(want)
+        if not devices:
+            self.store.update_train_job_status(job_id,
+                                               TrainJobStatus.ERRORED.value)
+            for sub in self.store.get_sub_train_jobs(job_id):
+                self.store.update_sub_train_job(
+                    sub["id"], status=TrainJobStatus.ERRORED.value)
+            events.emit("train_job_finished", job_id=job_id,
+                        status=TrainJobStatus.ERRORED.value,
+                        duration_s=round(time.time() - t0, 3),
+                        degraded=True)
+            return TrainJobResult(
+                job_id=job_id,
+                status=TrainJobStatus.ERRORED.value,
+                trials=[],
+                best_trials=[],
+                duration_s=time.time() - t0,
+                errors=["mesh sweep: no device obtainable"],
+            )
         k = max(1, int(trials_per_chip))
 
         errors: List[str] = []
@@ -268,6 +313,7 @@ class MeshSweepScheduler:
         """One sub-job's sweep: draft, claim, distribute, supervise."""
         job_id = job["id"]
         n_chips = len(devices)
+        assert n_chips >= 1, "mesh sweep needs at least one device"
         max_trials = budget.get(BudgetType.MODEL_TRIAL_COUNT.value)
         budget_max = int(max_trials) if max_trials is not None else None
         n_slots = n_chips * k
@@ -299,7 +345,8 @@ class MeshSweepScheduler:
                 job_created_at=job["created_at"], service_id=service["id"],
                 stop_event=stop_event, async_persist=False,
             )
-            runners.append(_ChipRunner(i, dev, worker, k, errors))
+            runners.append(_ChipRunner(i, dev, worker, k, errors,
+                                       budget_max=budget_max))
 
         # Claim every row up front (atomic budget slots), bucketed by
         # packing key — only same-key rows may share a pack — then
@@ -325,9 +372,13 @@ class MeshSweepScheduler:
             buckets[key].append((trial["id"], kn))
         assign: List[List[List[tuple]]] = [[[] for _ in order]
                                            for _ in runners]
+        # Global round-robin cursor: restarting at chip 0 per bucket
+        # would pile every singleton bucket onto chip 0.
+        cursor = 0
         for b, key in enumerate(order):
-            for j, row in enumerate(buckets[key]):
-                assign[j % n_chips][b].append(row)
+            for row in buckets[key]:
+                assign[cursor % n_chips][b].append(row)
+                cursor += 1
         for r, per_bucket in zip(runners, assign):
             for rows in per_bucket:
                 if rows:
@@ -423,6 +474,13 @@ class MeshSweepScheduler:
             pending_reap = [r for r in runners
                             if not r.alive() and not r.reaped]
             if stop_event.is_set():
+                # Abort every live runner so in-flight packs unwind at
+                # their next epoch boundary (rows stay RUNNING, same as
+                # the chip-loss path) instead of daemon threads training
+                # past the join timeout and writing to the store after
+                # the STOPPED result is returned.
+                for r in live:
+                    r.abort.set()
                 break
             if not pending_reap and (not live or all(r.idle() for r in live)):
                 break

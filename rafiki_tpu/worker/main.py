@@ -118,6 +118,18 @@ def main() -> int:
     if coordinator:
         from rafiki_tpu import chaos
 
+        # jax gates cross-process CPU collectives behind a config
+        # switch; without gloo a multi-process CPU group dies at first
+        # program init with "Multiprocess computations aren't
+        # implemented on the CPU backend". Must land before the backend
+        # client is created; irrelevant (and skipped) on TPU platforms.
+        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass  # older jax: CPU collectives need no gate
+
         process_id = int(os.environ["RAFIKI_PROCESS_ID"])
         # Start-skew site: a delay-mode fault here staggers this
         # process's arrival at the collective barrier (leader/follower

@@ -293,6 +293,32 @@ def test_pack_straggler_evicted_and_backfilled(env):
         "no freshly proposed trial was admitted mid-pack"
 
 
+def test_mesh_backfill_respects_trial_budget(env):
+    """Mid-pack backfill on the MESH path must claim atomic budget
+    slots. Threshold 0.005 puts the seed-0 proposal sequence (0.0087,
+    0.0025, 0.0011, …) one early-stopper per pack round: each eviction
+    frees a slot that backfill refills until MODEL_TRIAL_COUNT drains.
+    Without budget_max threaded through the chip runner into
+    run_assigned, backfill's create_trial skips the slot claim — trials
+    exceed the budget and the pack never drains (this test hanging is
+    the failure mode)."""
+    from rafiki_tpu.scheduler import MeshSweepScheduler
+
+    store, params, _ = env
+    src = EVICT_SOURCE.replace(b">= 0.02", b">= 0.005")
+    model = store.create_model("allstopff", "IMAGE_CLASSIFICATION", None,
+                               src, "EvictFF")
+    job = _job(store, model, {"MODEL_TRIAL_COUNT": 5})
+    result = MeshSweepScheduler(store, params).run_sweep(
+        job["id"], chips=1, trials_per_chip=2, advisor_kind="random")
+    assert result.status == "COMPLETED", result.errors
+    trials = store.get_trials_of_train_job(job["id"])
+    assert len(trials) == 5, \
+        f"backfill bypassed the trial-count budget ({len(trials)} rows)"
+    assert all(t["status"] == "COMPLETED" for t in trials)
+    assert all(t.get("score") is not None for t in trials)
+
+
 def test_mesh_degrades_to_single_chip(env, monkeypatch):
     from rafiki_tpu import telemetry
     from rafiki_tpu.chaos import FaultPlane, install, uninstall
