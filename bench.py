@@ -98,6 +98,11 @@ BASELINE_TRIALS_PER_HOUR_PER_GPU = 120.0  # estimate — BASELINE.md §Baseline 
 V5E_BF16_PEAK_FLOPS = 197e12
 CANON_TRAIN, CANON_EVAL = 50_000, 10_000
 
+#: Artifact schema: 1 = the historical BENCH_r* shape (no marker);
+#: 2 adds this field plus the ``headline`` block. Bump when a consumer
+#: (scripts/bench_report.py) would need to branch on the shape.
+BENCH_SCHEMA_VERSION = 2
+
 _OUT = {
     "metric": "cifar10_automl_trials_per_hour",
     "value": 0.0,
@@ -122,6 +127,20 @@ def _emit(error: str | None = None) -> None:
             return
         if error is not None:
             _OUT["error"] = error
+        # Stamped here, not at detail-build time, so every artifact
+        # shape (full, degraded, watchdog-partial, error) carries the
+        # same headline block for scripts/bench_report.py to trend.
+        # Older rounds spelled some keys differently — .get fallbacks,
+        # absent keys trend as no-data rather than KeyError.
+        d = _OUT.get("detail") or {}
+        _OUT["schema_version"] = BENCH_SCHEMA_VERSION
+        _OUT["headline"] = {
+            "trials_per_hour": _OUT.get("value"),
+            "canonical_trial_s": d.get("canonical_trial_s",
+                                       d.get("canonical_compute_s")),
+            "compile_s": d.get("compile_s", d.get("cold_trial_s")),
+            "train_img_per_s": d.get("train_img_per_s"),
+        }
         line = None
         for _ in range(3):
             try:

@@ -172,7 +172,15 @@ class Gateway:
         finally:
             self.admission.release()
         # lint: disable=RF007 — breaker EWMA input; region is under the span
-        self._absorb(report, time.monotonic() - t0)
+        elapsed = time.monotonic() - t0
+        self._absorb(report, elapsed)
+        # End-to-end latency reservoir: the p99 the gateway latency SLO
+        # evaluates (docs/perf.md). The gather span measures the same
+        # region but span summaries don't feed SLO sources directly.
+        telemetry.observe("gateway.predict_s", elapsed)
+        from rafiki_tpu.obs.perf import slo as _slo
+
+        _slo.maybe_tick()
         return report.outputs
 
     # -- routing -------------------------------------------------------------
@@ -250,6 +258,9 @@ class Gateway:
         with self._lock:
             self._shed[reason] = self._shed.get(reason, 0) + 1
         telemetry.inc("gateway.shed")
+        # Reasons are a closed enum of admission code paths, refining
+        # the stable literal gateway.shed aggregate above.
+        # lint: disable=RF008 — bounded shed-reason enum under a literal aggregate
         telemetry.inc(f"gateway.shed_{reason}")
         _journal.record("gateway", "shed", reason=reason)
 

@@ -10,6 +10,9 @@ Four layers on top of :mod:`rafiki_tpu.telemetry`:
   step vs feed vs checkpoint vs downtime) per trial/pack/job;
 * :mod:`~rafiki_tpu.obs.recorder` — flight recorder dumping the last-N
   ring to disk on fatal/interrupt;
+* :mod:`~rafiki_tpu.obs.perf` — perf sentinel: per-program cost
+  profiling, SLO burn-rate alerting, step-time anomaly detection
+  (docs/perf.md);
 
 plus :mod:`~rafiki_tpu.obs.prom` (Prometheus text exposition of the
 registry snapshot) and the ``python -m rafiki_tpu.obs`` CLI
@@ -26,7 +29,7 @@ import importlib
 
 from rafiki_tpu.obs import context, journal  # noqa: F401  (eager, dep-free)
 
-_LAZY = ("ledger", "prom", "recorder", "cli")
+_LAZY = ("ledger", "perf", "prom", "recorder", "cli")
 
 __all__ = ["context", "journal", *_LAZY, "configure_from_env"]
 

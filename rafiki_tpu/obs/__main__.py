@@ -1,6 +1,8 @@
 import sys
 
 from rafiki_tpu.obs.cli import main
+from rafiki_tpu.utils.backend import honor_env_platform
 
 if __name__ == "__main__":
+    honor_env_platform()
     sys.exit(main())

@@ -8,6 +8,13 @@ Subcommands (all read ``journal-*.jsonl*`` under ``--dir``, default
                    the stitched end-to-end view of one query or trial
     tail [-n N]    the last N records fleet-wide
     slowest [-n N] the N slowest finished spans
+    profile [key]  per-program roofline join: XLA cost model
+                   (``perf/cost``) x observed step times (``perf/step``)
+                   -> achieved FLOP/s, MFU, arithmetic intensity
+                   (docs/perf.md); ``key`` prefix-matches the program
+                   key hash or substring-matches the key repr
+    slo            current SLO burn state (latest ``slo/state``) plus
+                   the breach/recovery history
 
 Output is one human line per record by default, ``--json`` for JSONL
 (pipe into jq). Exit code 1 when a requested trace has no records.
@@ -88,7 +95,137 @@ def cmd_slowest(log_dir: str, n: int, as_json: bool) -> int:
     return 0
 
 
+def cmd_profile(log_dir: str, key: Optional[str], as_json: bool,
+                peak_flops: Optional[float]) -> int:
+    """Join perf/cost x perf/step journal records into per-program
+    MFU/roofline rows (the cross-process sibling of the live ``perf``
+    telemetry collector)."""
+    records = journal_mod.read_dir(log_dir)
+    costs: Dict[str, Dict[str, Any]] = {}
+    steps: Dict[str, List[float]] = {}
+    colds: Dict[str, List[float]] = {}
+    for r in records:
+        if r.get("kind") != "perf":
+            continue
+        h = r.get("key_hash")
+        if not h:
+            continue
+        if r.get("name") == "cost":
+            costs[h] = r  # latest wins: re-captures supersede
+        elif r.get("name") == "step":
+            dt = r.get("dt")
+            if dt is None:
+                continue
+            (colds if r.get("cold") else steps).setdefault(h, []).append(
+                float(dt) - float(r.get("feed_s") or 0.0))
+    hashes = sorted(set(costs) | set(steps) | set(colds))
+    if key:
+        hashes = [h for h in hashes
+                  if h.startswith(key) or key in str(costs.get(h, {}).get("key", ""))]
+    if not hashes:
+        print(f"no perf records{f' matching {key!r}' if key else ''} "
+              f"under {log_dir}", file=sys.stderr)
+        return 1
+    if peak_flops is None:
+        from rafiki_tpu.obs.perf import profiler
+        peak_flops = profiler.PEAK_FLOPS_V5E_BF16
+    rows = []
+    for h in hashes:
+        c = costs.get(h, {})
+        warm = sorted(steps.get(h, []))
+        row: Dict[str, Any] = {
+            "key_hash": h,
+            "key": c.get("key"),
+            "kind": c.get("program_kind"),
+            "k": c.get("k"),
+            "flops": c.get("flops"),
+            "bytes_accessed": c.get("bytes_accessed"),
+            "peak_hbm_bytes": c.get("peak_hbm_bytes"),
+            "epochs": len(warm),
+            "cold_epochs": len(colds.get(h, [])),
+        }
+        if warm:
+            row["step_p50_s"] = warm[len(warm) // 2]
+            row["step_min_s"] = warm[0]
+        if c.get("flops") and c.get("bytes_accessed"):
+            row["arith_intensity"] = c["flops"] / c["bytes_accessed"]
+        if c.get("flops") and warm:
+            row["achieved_flops_s"] = c["flops"] / row["step_p50_s"]
+            # MFU claims a hardware peak: only meaningful when the
+            # steps ran on an accelerator. The journal can't know, so
+            # the report states its basis instead of guessing.
+            row["mfu_vs_peak"] = row["achieved_flops_s"] / peak_flops
+            row["peak_flops_basis"] = peak_flops
+        rows.append(row)
+    if as_json:
+        print(json.dumps({"programs": rows}, default=str))
+        return 0
+    for row in rows:
+        print(f"program {row['key_hash']}  kind={row['kind'] or '?'} "
+              f"k={row['k'] or '?'} epochs={row['epochs']}"
+              f" (+{row['cold_epochs']} cold)")
+        if row.get("key"):
+            print(f"  key: {row['key']}")
+        if row.get("flops"):
+            print(f"  cost model: {row['flops']:.3e} flops, "
+                  f"{row.get('bytes_accessed') or 0:.3e} bytes"
+                  + (f", AI={row['arith_intensity']:.2f} flops/byte"
+                     if row.get("arith_intensity") else ""))
+        if row.get("step_p50_s") is not None:
+            print(f"  observed: p50 step {row['step_p50_s'] * 1e3:.3f}ms "
+                  f"(min {row['step_min_s'] * 1e3:.3f}ms)")
+        if row.get("achieved_flops_s"):
+            print(f"  achieved: {row['achieved_flops_s']:.3e} FLOP/s "
+                  f"-> MFU {row['mfu_vs_peak'] * 100:.4f}% of "
+                  f"{row['peak_flops_basis']:.3g} peak")
+    return 0
+
+
+def cmd_slo(log_dir: str, as_json: bool) -> int:
+    """Latest slo/state snapshot + full breach/recovery history."""
+    records = journal_mod.read_dir(log_dir)
+    state = None
+    breaches: List[Dict[str, Any]] = []
+    recoveries: List[Dict[str, Any]] = []
+    for r in records:
+        if r.get("kind") != "slo":
+            continue
+        if r.get("name") == "state":
+            state = r
+        elif r.get("name") == "breach":
+            breaches.append(r)
+        elif r.get("name") == "recover":
+            recoveries.append(r)
+    if state is None and not breaches:
+        print(f"no slo records under {log_dir} (is the engine ticking? "
+              f"see docs/perf.md)", file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps({"state": state, "breaches": breaches,
+                          "recoveries": recoveries}, default=str))
+        return 0
+    if state is not None:
+        print(f"slo state @ ts={state.get('ts')}:")
+        for name, st in sorted((state.get("state") or {}).items()):
+            mark = "BREACH" if st.get("breaching") else "ok"
+            val = st.get("value")
+            burn = st.get("burn")
+            print(f"  {name:<24} {mark:<7} value="
+                  f"{'n/a' if val is None else format(val, '.4g')} "
+                  f"threshold={st.get('threshold')}"
+                  + (f" burn={burn:.2f}x" if burn is not None else ""))
+    print(f"breaches: {len(breaches)}, recoveries: {len(recoveries)}")
+    for b in breaches[-8:]:
+        print(f"  ts={b.get('ts')} {b.get('slo')} value={b.get('value')} "
+              f"threshold={b.get('threshold')} ({b.get('source')})")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()  # profile's peak-flops default imports the
+    # profiler package; pin the platform before anything can touch jax.
     p = argparse.ArgumentParser(
         prog="python -m rafiki_tpu.obs",
         description="merge and query the per-process observability journals")
@@ -104,6 +241,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp.add_argument("-n", type=int, default=32)
     sp = sub.add_parser("slowest", help="N slowest spans")
     sp.add_argument("-n", type=int, default=16)
+    sp = sub.add_parser("profile",
+                        help="per-program cost model x step-time join")
+    sp.add_argument("key", nargs="?", default=None,
+                    help="program key-hash prefix or key substring")
+    sp.add_argument("--peak-flops", type=float, default=None,
+                    help="MFU denominator (default: v5e bf16 peak)")
+    sub.add_parser("slo", help="current SLO burn state + breach history")
     args = p.parse_args(argv)
 
     log_dir = args.dir or _default_dir()
@@ -111,4 +255,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_trace(log_dir, args.trace_id, args.json)
     if args.cmd == "tail":
         return cmd_tail(log_dir, args.n, args.json)
+    if args.cmd == "profile":
+        return cmd_profile(log_dir, args.key, args.json, args.peak_flops)
+    if args.cmd == "slo":
+        return cmd_slo(log_dir, args.json)
     return cmd_slowest(log_dir, args.n, args.json)

@@ -13,6 +13,8 @@ section) buckets of
     feed_s        host→device feed stalls
     checkpoint_s  checkpoint/persist writes
     downtime_s    chaos-injected delays and death→respawn gaps
+    badput_s      anomaly excess: wall an epoch spent over its EWMA
+                  baseline (the perf sentinel's regression charge)
 
 rolled up to ``goodput = productive_step_s / wall_s`` per entity and
 fleet-wide. The roll-up is exposed as the ``goodput`` telemetry
@@ -36,7 +38,8 @@ from typing import Any, Dict, Iterator, Optional
 from rafiki_tpu import telemetry
 from rafiki_tpu.obs.journal import journal as _journal
 
-BUCKETS = ("compile_s", "step_s", "feed_s", "checkpoint_s", "downtime_s")
+BUCKETS = ("compile_s", "step_s", "feed_s", "checkpoint_s", "downtime_s",
+           "badput_s")
 
 #: Fallback entity for charges made outside any ``entity()`` block.
 DEFAULT_ENTITY = "process"

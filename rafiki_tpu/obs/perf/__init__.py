@@ -1,0 +1,20 @@
+"""Perf sentinel: continuous profiling, SLOs, and anomaly detection.
+
+Three coupled pieces (docs/perf.md):
+
+* :mod:`~rafiki_tpu.obs.perf.profiler` — per-program XLA cost capture
+  joined with observed step times (MFU/roofline), the ``perf``
+  telemetry collector and the ``perf/*`` journal records.
+* :mod:`~rafiki_tpu.obs.perf.slo` — declarative SLO specs evaluated
+  as multi-window burn rates; breaches journal, count, and trip the
+  flight recorder.
+* :mod:`~rafiki_tpu.obs.perf.anomaly` — the EWMA+MAD detector the
+  profiler runs over every program's step/compile times.
+
+Importing this package registers the ``perf`` and ``slo`` telemetry
+collectors. It never imports jax at module scope.
+"""
+
+from rafiki_tpu.obs.perf import anomaly, profiler, slo
+
+__all__ = ["anomaly", "profiler", "slo"]

@@ -473,9 +473,10 @@ class TrainLoop:
                            _ShardingPlan.build(mesh), dynamic_lr=dynamic_lr)
 
         if program_key is not None:
-            self.program = get_program(
-                (program_key, mesh_cache_key(mesh), dynamic_lr), build)
+            self._perf_key = (program_key, mesh_cache_key(mesh), dynamic_lr)
+            self.program = get_program(self._perf_key, build)
         else:
+            self._perf_key = ("serial", "anon", id(self))
             self.program = build()
         self.plan = self.program.plan
         self.apply_fn = apply_fn
@@ -538,12 +539,25 @@ class TrainLoop:
                         key=f"p{jax.process_index()}:"
                             f"{_os.environ.get('RAFIKI_WORKER_ID', '')}")
         t_epoch = time.monotonic()
+        # Chaos site INSIDE the timed region (unlike collective.step
+        # above): an injected delay here inflates the measured epoch
+        # wall, which is exactly what the perf sentinel's anomaly
+        # detector watches — perf_smoke.py drives it through this site.
+        from rafiki_tpu import chaos as _chaos
+
+        _chaos.hook("train.epoch", key=str(self._perf_key))
         if on_metrics is None and self._fits_device_fast_path(dataset):
             X, Y = get_device_dataset(dataset)
             n_steps = dataset.size // batch_size
             perm = np.random.default_rng(epoch_seed).permutation(dataset.size)
             idx = perm[: n_steps * batch_size].reshape(
                 n_steps, batch_size).astype(np.int32)
+            if not getattr(self, "_warm", False):
+                from rafiki_tpu.obs.perf import profiler as _profiler
+
+                _profiler.capture_cost(self._perf_key,
+                                       self.program.train_epoch,
+                                       self.state, X, Y, idx)
             self.state, metrics = self.program.train_epoch(self.state, X, Y, idx)
             out = {k: float(v) for k, v in metrics.items()}
             self._record_epoch(t_epoch, feed_s=0.0)
@@ -572,6 +586,11 @@ class TrainLoop:
             return dev
 
         dev_batch = put_next()
+        if dev_batch is not None and not getattr(self, "_warm", False):
+            from rafiki_tpu.obs.perf import profiler as _profiler
+
+            _profiler.capture_cost(self._perf_key, self._train_step,
+                                   self.state, dev_batch)
         while dev_batch is not None:
             self.state, metrics = self._train_step(self.state, dev_batch)
             dev_batch = put_next()  # overlaps the in-flight step
@@ -604,6 +623,12 @@ class TrainLoop:
             ledger.add("feed_s", feed_s)
         telemetry.inc("train.step_s", max(dt - feed_s, 0.0))
         ledger.add("compile_s" if cold else "step_s", max(dt - feed_s, 0.0))
+        # Perf sentinel: step sampling + EWMA/MAD anomaly detection per
+        # program, and an SLO evaluation tick (both cheap when idle).
+        from rafiki_tpu.obs.perf import profiler, slo
+
+        profiler.note_epoch(self._perf_key, dt, feed_s=feed_s, cold=cold)
+        slo.maybe_tick()
 
     def evaluate(self, dataset, batch_size: int) -> float:
         total_correct = jnp.zeros((), jnp.int32)
@@ -799,9 +824,10 @@ class PackedTrainLoop:
                                  dynamic_lr=dynamic_lr)
 
         if self._program_key is not None:
-            self.program = get_program(
-                packed_program_key(self._program_key, k, dynamic_lr), build)
+            self._perf_key = packed_program_key(self._program_key, k, dynamic_lr)
+            self.program = get_program(self._perf_key, build)
         else:
+            self._perf_key = ("packed", "anon", id(self), k)
             self.program = build()
         self.plan = self.program.plan
         self.optimizer = self.program.optimizer
@@ -888,6 +914,11 @@ class PackedTrainLoop:
                 f"Dataset has {dataset.size} examples < batch_size={batch_size}; "
                 f"the epoch would run zero steps")
         t_epoch = time.monotonic()
+        # Same in-timed-region chaos site as the serial loop: injected
+        # delays here are visible to the anomaly detector.
+        from rafiki_tpu import chaos as _chaos
+
+        _chaos.hook("train.epoch", key=str(self._perf_key))
         n_steps = dataset.size // batch_size
         # (n_steps, k, batch): step-major so lax.scan walks steps while
         # each trial keeps its own serial-identical permutation.
@@ -897,6 +928,13 @@ class PackedTrainLoop:
             for s in epoch_seeds], axis=1).astype(np.int32)
         if self._fits_device_fast_path(dataset):
             X, Y = get_device_dataset(dataset)
+            if not getattr(self, "_warm", False):
+                from rafiki_tpu.obs.perf import profiler as _profiler
+
+                _profiler.capture_cost(self._perf_key,
+                                       self.program.train_epoch,
+                                       self.state, X, Y, idx,
+                                       kind="packed", k=self.k)
             self.state, metrics = self.program.train_epoch(self.state, X, Y, idx)
             self._record_epoch(t_epoch)
             host = {key: np.asarray(jax.device_get(v)) for key, v in metrics.items()}
@@ -925,6 +963,11 @@ class PackedTrainLoop:
         # Goodput ledger: same convention as the serial loop — the cold
         # (compile-paying) epoch is overhead, warm epochs are productive.
         ledger.add("compile_s" if cold else "step_s", dt)
+        from rafiki_tpu.obs.perf import profiler, slo
+
+        profiler.note_epoch(self._perf_key, dt, cold=cold,
+                            kind="packed", k=self.k)
+        slo.maybe_tick()
 
     def evaluate(self, dataset, batch_size: int) -> np.ndarray:
         """(k,) per-trial accuracies over one shared eval pass: the

@@ -199,6 +199,9 @@ class Predictor:
                         queries=len(queries), workers=list(workers),
                         quorum=quorum, replies=replies, timeouts=timeouts,
                         hedged=hedged, dur_s=round(elapsed, 6))
+        from rafiki_tpu.obs.perf import slo as _slo
+
+        _slo.maybe_tick()
         return GatherReport(outputs=out, workers=list(workers),
                             quorum=quorum, replies=replies,
                             timeouts=timeouts, hedged=hedged,

@@ -484,6 +484,12 @@ class MeshSweepScheduler:
                 break
             if not pending_reap and (not live or all(r.idle() for r in live)):
                 break
+            # SLO tick from the supervision loop: the mesh downtime
+            # budget burns here even when no epoch/request path is
+            # active to tick it (docs/perf.md).
+            from rafiki_tpu.obs.perf import slo as _slo
+
+            _slo.maybe_tick()
             time.sleep(0.02)
 
         for r in runners:

@@ -31,6 +31,7 @@ from rafiki_tpu.model.base import BaseModel, load_model_class
 from rafiki_tpu.model.knobs import Knobs, knob_config_signature
 from rafiki_tpu.model.log import logger
 from rafiki_tpu.obs import context as trace_context
+from rafiki_tpu.obs.journal import journal
 from rafiki_tpu.obs.ledger import ledger
 from rafiki_tpu.store import MetaStore, ParamsStore
 from rafiki_tpu.utils.events import events
@@ -60,6 +61,29 @@ class InProcAdvisorHandle:
 
     def feedback(self, score: float, knobs: Knobs) -> None:
         self._svc.feedback(self._id, score, knobs)
+
+
+def _journal_epoch_eval(trial_id: str, entry: Dict[str, Any],
+                        wall_s: Optional[float],
+                        packed: bool = False) -> None:
+    """Durable per-epoch learning-curve record (``trial/epoch_eval``):
+    the substrate the learning-curve-predictive advisor needs — eval
+    curves survive the worker process instead of living only in the
+    sqlite trial log. No-op for non-epoch log entries and when no
+    journal is configured."""
+    if entry.get("type") != "values":
+        return
+    values = entry.get("values") or {}
+    if "epoch" not in values:
+        return
+    score = values.get("acc", values.get("loss"))
+    journal.record(
+        "trial", "epoch_eval", trial_id=trial_id,
+        epoch=int(values["epoch"]),
+        score=None if score is None else float(score),
+        loss=values.get("loss"), acc=values.get("acc"),
+        wall_s=None if wall_s is None else round(float(wall_s), 6),
+        packed=packed)
 
 
 class PackAborted(RuntimeError):
@@ -164,9 +188,13 @@ class TrainWorker:
             if trial is None:
                 return None
         tid = trial["id"]
+        t_trial0 = time.monotonic()
 
         def sink(entry):
             self.store.add_trial_log(tid, entry)
+            _journal_epoch_eval(tid, entry,
+                               # lint: disable=RF007 — epoch_eval wall field, already under trial.total
+                               wall_s=time.monotonic() - t_trial0)
             if self.service_id is not None:
                 # Epoch logs double as liveness: long trials heartbeat
                 # from inside, so failure detection doesn't flag them.
@@ -587,7 +615,15 @@ class PackedTrialRunner:
                 with telemetry.span("trial_pack.build"):
                     models = [w.model_class(**kn) for _, kn in rows]
 
+                t_pack0 = time.monotonic()
+                round_walls: List[float] = []
+
                 def heartbeat(_epoch: int) -> None:
+                    # Pack-relative wall at each round boundary: the
+                    # post-hoc epoch_eval journal replay (below) joins
+                    # member epoch -> round position -> this wall.
+                    # lint: disable=RF007 — epoch_eval wall field, already under trial_pack.total
+                    round_walls.append(time.monotonic() - t_pack0)
                     # Abort lands at the epoch boundary AFTER the
                     # checkpoint sink ran, so the newest epoch of every
                     # member is durable before the pack unwinds.
@@ -708,8 +744,18 @@ class PackedTrialRunner:
 
             with logger.capture(sink):
                 logger.define_plot("Training", ["loss", "acc"], x_axis="epoch")
-                for h in histories[i]:
+                for pos, h in enumerate(histories[i]):
                     logger.log(**h)
+                    # Position in a member's history == the round it
+                    # ran at (exact for whole-pack members; backfilled
+                    # members join mid-pack, so their early positions
+                    # borrow the pack's early-round walls — close, and
+                    # honest about being pack-relative).
+                    _journal_epoch_eval(
+                        tid, {"type": "values", "values": h},
+                        wall_s=(round_walls[pos]
+                                if pos < len(round_walls) else None),
+                        packed=True)
             score = float(scores[i])
             w.advisor.feedback(score, kn)
             telemetry.inc("worker.trials_succeeded")

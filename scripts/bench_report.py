@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Bench regression gate: trend the BENCH_r*.json history, verdict it.
+
+Each bench round leaves an artifact — either the driver wrapper
+``{"n": ..., "cmd": ..., "rc": ..., "tail": [...], "parsed": {...}}``
+or a raw ``bench.py`` result line. This report joins them into one
+trajectory per headline metric and renders a verdict:
+
+  regressed     latest measurable value is worse than the best prior
+                measurable value by more than ``--tolerance``
+  improved      better than the best prior value by more than tolerance
+  flat          within tolerance of the best prior value
+  single-point  only one round ever measured this metric (no trend)
+  no-data       no round measured it at all
+
+"Measurable" is deliberately strict: a round whose payload carries an
+``error`` (TPU tunnel down, watchdog fired) or a null/zero value is
+**no data**, not a zero — r03–r05's backend-unavailable artifacts must
+not read as a 100% throughput regression against r02's real number.
+
+Schema tolerance runs both directions: schema>=2 artifacts carry a
+``headline`` block (bench.py stamps it); older rounds are backfilled
+from ``value`` + ``detail`` with the same key fallbacks bench.py uses.
+
+Output: one JSON document on stdout (schema_versioned, machine-first —
+scripts/perf_smoke.py subprocesses this as a CI gate); the exit code is
+the verdict: 0 clean, 1 any metric regressed, 2 unreadable history.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+REPORT_SCHEMA_VERSION = 1
+DEFAULT_TOLERANCE = 0.10
+
+#: Headline metrics and which direction is good. Keys match the
+#: bench.py ``headline`` block.
+METRICS = {
+    "trials_per_hour": "higher",
+    "train_img_per_s": "higher",
+    "canonical_trial_s": "lower",
+    "compile_s": "lower",
+}
+
+
+def _payload_from_tail(tail: Any) -> Optional[Dict[str, Any]]:
+    """Backfill path: no ``parsed`` block, so scan the captured stdout
+    tail from the end for the single bench result line. Tail chunks are
+    arbitrary splits, so join first and walk whole lines."""
+    if not tail:
+        return None
+    text = "".join(str(t) for t in tail)
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and ("value" in obj or "metric" in obj):
+            return obj
+    return None
+
+
+def load_round(path: str) -> Dict[str, Any]:
+    """One artifact file -> {round, path, rc, payload}. Never raises on
+    a malformed file: it becomes a payload-less round (= no data)."""
+    name = os.path.basename(path)
+    out: Dict[str, Any] = {"path": name, "round": name, "rc": None,
+                           "payload": None, "source": None}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+        return out
+    if not isinstance(doc, dict):
+        out["error"] = "artifact is not a JSON object"
+        return out
+    if "metric" in doc or "headline" in doc:
+        # A raw bench.py result line saved directly, no driver wrapper.
+        out["payload"], out["source"] = doc, "raw"
+        return out
+    out["round"] = doc.get("n", name)
+    out["rc"] = doc.get("rc")
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        out["payload"], out["source"] = parsed, "parsed"
+    else:
+        out["payload"] = _payload_from_tail(doc.get("tail"))
+        out["source"] = "tail" if out["payload"] else None
+    return out
+
+
+def headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """The metric block to trend. An ``error``-bearing payload yields
+    nothing: its zeros mean "did not run", not "ran this slow"."""
+    if not isinstance(payload, dict) or payload.get("error"):
+        return {}
+    h = payload.get("headline")
+    if isinstance(h, dict):
+        return h
+    d = payload.get("detail") or {}
+    return {  # pre-schema_version backfill — mirrors bench.py._emit
+        "trials_per_hour": payload.get("value"),
+        "canonical_trial_s": d.get("canonical_trial_s",
+                                   d.get("canonical_compute_s")),
+        "compile_s": d.get("compile_s", d.get("cold_trial_s")),
+        "train_img_per_s": d.get("train_img_per_s"),
+    }
+
+
+def _measurable(v: Any) -> bool:
+    return isinstance(v, (int, float)) and v > 0
+
+
+def trend(rounds: List[Dict[str, Any]],
+          tolerance: float) -> Dict[str, Dict[str, Any]]:
+    """Per-metric trajectory + verdict. Latest measurable point vs the
+    best prior measurable point, with a relative tolerance band."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for metric, direction in METRICS.items():
+        points = []
+        for r in rounds:
+            v = headline_of(r["payload"]).get(metric)
+            points.append({"round": r["round"],
+                           "value": v if _measurable(v) else None})
+        measured = [p for p in points if p["value"] is not None]
+        entry: Dict[str, Any] = {"direction": direction,
+                                 "trajectory": points,
+                                 "n_measured": len(measured)}
+        if not measured:
+            entry["verdict"] = "no-data"
+        elif len(measured) == 1:
+            entry["verdict"] = "single-point"
+            entry["latest"] = measured[-1]["value"]
+        else:
+            latest = measured[-1]["value"]
+            prior = [p["value"] for p in measured[:-1]]
+            best = max(prior) if direction == "higher" else min(prior)
+            # Signed fraction, positive = worse, in units of the best
+            # prior value — one tolerance knob works for both signs.
+            delta = ((best - latest) if direction == "higher"
+                     else (latest - best)) / best
+            entry.update({"latest": latest, "best_prior": best,
+                          "delta_frac": round(delta, 4)})
+            if delta > tolerance:
+                entry["verdict"] = "regressed"
+            elif delta < -tolerance:
+                entry["verdict"] = "improved"
+            else:
+                entry["verdict"] = "flat"
+        out[metric] = entry
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="scripts/bench_report.py",
+        description="trend BENCH_r*.json artifacts, exit 1 on regression")
+    p.add_argument("artifacts", nargs="*",
+                   help="artifact files in round order "
+                        "(default: BENCH_r*.json next to bench.py)")
+    p.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                   help="relative regression band (default 0.10)")
+    args = p.parse_args(argv)
+
+    paths = args.artifacts
+    if not paths:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    if not paths:
+        print(json.dumps({"error": "no bench artifacts found"}))
+        return 2
+
+    rounds = [load_round(pth) for pth in paths]
+    metrics = trend(rounds, args.tolerance)
+    regressed = sorted(m for m, e in metrics.items()
+                       if e["verdict"] == "regressed")
+    report = {
+        "schema_version": REPORT_SCHEMA_VERSION,
+        "tolerance": args.tolerance,
+        "n_rounds": len(rounds),
+        "rounds": [{"round": r["round"], "rc": r["rc"],
+                    "source": r["source"],
+                    "has_data": bool(headline_of(r["payload"]))}
+                   for r in rounds],
+        "metrics": metrics,
+        "regressed": regressed,
+        "verdict": "regressed" if regressed else "ok",
+    }
+    print(json.dumps(report))
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

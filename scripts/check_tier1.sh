@@ -32,6 +32,13 @@ env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py > /tmp/_chaos_smoke.json \
 # /metrics?format=prom must line-parse (docs/observability.md). ~6s.
 env JAX_PLATFORMS=cpu python scripts/obs_smoke.py > /tmp/_obs_smoke.json \
   || { echo "TIER1 OBS SMOKE FAILED (see /tmp/_obs_smoke.json)"; exit 1; }
+# Perf-sentinel smoke: bench_report must gate both ways on the
+# BENCH_r* history, an uninjected packed round must profile clean
+# (obs profile reports packed-program MFU, zero anomalies/breaches),
+# and an injected 0.25s epoch delay must land anomaly -> SLO breach
+# -> flight record (docs/perf.md). ~7s.
+env JAX_PLATFORMS=cpu python scripts/perf_smoke.py > /tmp/_perf_smoke.json \
+  || { echo "TIER1 PERF SMOKE FAILED (see /tmp/_perf_smoke.json)"; exit 1; }
 # Mesh-sweep smoke: a 2-virtual-chip elastic sweep with one injected
 # chip loss (docs/mesh_sweep.md) — re-packs onto the survivor, every
 # trial scores, resumed params bit-match serial. ~10s; a vacuous pass

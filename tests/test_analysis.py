@@ -29,7 +29,7 @@ def _analyze_snippet(tmp_path, source, name="snippet.py", select=None):
 
 def test_all_builtin_checkers_registered():
     assert {"RF001", "RF002", "RF003", "RF004", "RF005",
-            "RF006"} <= set(REGISTRY)
+            "RF006", "RF007", "RF008"} <= set(REGISTRY)
 
 
 # ---------------------------------------------------------------------------
@@ -529,6 +529,84 @@ def test_suppression_without_justification_does_not_suppress(tmp_path):
         """)
     assert len(r.unsuppressed) == 1
     assert "no justification" in r.unsuppressed[0].message
+
+
+# ---------------------------------------------------------------------------
+# RF008 metric-name-drift
+# ---------------------------------------------------------------------------
+
+
+def test_rf008_fires_on_dynamic_metric_names(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        from rafiki_tpu import telemetry
+
+        def f(site, mode, n):
+            telemetry.inc(f"chaos.injected.{site}.{mode}")
+            name = "worker." + str(n)
+            telemetry.observe(name, 1.0)
+            telemetry.set_gauge("bus." + "depth", 2)
+        """)
+    assert [f.checker_id for f in r.unsuppressed] == ["RF008"] * 3
+
+
+def test_rf008_quiet_on_static_names(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        from rafiki_tpu import telemetry
+
+        COLD_METRIC = "train.cold_epoch_s"
+
+        class Names:
+            EPOCH = "train.epoch_s"
+
+        def f(cold):
+            telemetry.inc("train.epochs")
+            telemetry.observe(COLD_METRIC if cold else Names.EPOCH, 1.0)
+            with telemetry.span("worker.epoch"):
+                pass
+        """)
+    assert "RF008" not in _ids(r)
+
+
+def test_rf008_tracks_from_import_aliases(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        from rafiki_tpu.telemetry import inc as bump
+
+        def f(reason):
+            bump(f"gateway.shed.{reason}")
+        """)
+    assert [f.checker_id for f in r.unsuppressed] == ["RF008"]
+
+
+def test_rf008_justified_suppression_honored(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        from rafiki_tpu import telemetry
+
+        def f(reason):
+            # lint: disable=RF008 — bounded shed-reason enum
+            telemetry.inc(f"gateway.shed.{reason}")
+        """)
+    assert "RF008" not in _ids(r)
+
+
+def test_rf008_exempts_the_registry_itself(tmp_path):
+    obs = tmp_path / "rafiki_tpu" / "obs"
+    obs.mkdir(parents=True)
+    (tmp_path / "rafiki_tpu" / "__init__.py").write_text("")
+    (obs / "__init__.py").write_text("")  # module_name_for walks these
+    f = obs / "inner.py"
+    f.write_text("from rafiki_tpu import telemetry\n\n"
+                 "def flush(name):\n"
+                 "    telemetry.inc(f\"obs.flush.{name}\")\n")
+    r = analyze_paths([str(f)], select=["RF008"])
+    assert "RF008" not in _ids(r)
+
+
+def test_rf008_current_tree_is_clean():
+    r = analyze_paths([os.path.join(REPO, "rafiki_tpu"),
+                       os.path.join(REPO, "bench.py"),
+                       os.path.join(REPO, "scripts")], select=["RF008"])
+    mine = [f for f in r.unsuppressed if f.checker_id == "RF008"]
+    assert mine == [], [f"{f.path}:{f.line}" for f in mine]
 
 
 def test_suppression_only_covers_named_ids(tmp_path):

@@ -258,6 +258,18 @@ _SNAPSHOT = {
         "goodput": 0.25,
         "note": "strings have no prometheus representation",
     },
+    "perf": {
+        "n_programs": 1,
+        "programs": {"8c2d3ca7df": {"k": 1, "epochs": 4,
+                                    "step_p50_s": 0.005, "mfu": 0.41,
+                                    "kind": "strings are dropped"}},
+    },
+    "slo": {
+        "specs": 2,
+        "breaching": 1,
+        "state": {"step_anomaly_rate": {"breaching": 1, "threshold": 0.05,
+                                        "value": 0.2, "burn": 4.0}},
+    },
 }
 
 
