@@ -72,7 +72,11 @@ keeps its honest, reduced data point.
 per-trial and per-pack wall split into compile / step / feed /
 checkpoint / downtime buckets plus the job-level
 ``goodput = productive_step_s / wall_s`` ratio — present on BOTH the
-full and the degraded artifact. The accuracy gate is calibrated for
+full and the degraded artifact. ``detail.health`` (also on both
+shapes) carries the numerics health totals — divergences, capsules,
+evictions, contained trials, badput charged (docs/health.md) — so a
+NaN epidemic is named in the artifact instead of surfacing only as a
+throughput dip. The accuracy gate is calibrated for
 the canonical TPU scale; on plain CPU runs a miss is recorded as
 ``detail.top1_note`` but stays advisory (rc 0) unless the target was
 explicitly forced.
@@ -831,6 +835,15 @@ def _goodput_snapshot() -> dict:
     }
 
 
+def _health_snapshot() -> dict:
+    """Numerics health totals for the artifact: divergences caught,
+    capsules banked, pack evictions, contained trials, and the
+    wall-clock those divergences burned (already inside badput_s)."""
+    from rafiki_tpu.obs import health
+
+    return dict(health.stats())
+
+
 def main() -> None:
     deadline = float(os.environ.get("RAFIKI_BENCH_DEADLINE_S", "1500"))
     wd = _watchdog(deadline)
@@ -881,6 +894,7 @@ def main() -> None:
 
             detail["program_cache"] = program_cache_stats()
             detail["goodput"] = _goodput_snapshot()
+            detail["health"] = _health_snapshot()
             detail["telemetry"] = telemetry.snapshot()
             _OUT["value"] = None
             _OUT["vs_baseline"] = None
@@ -906,6 +920,11 @@ def main() -> None:
         # feed / checkpoint / downtime per trial (acceptance criterion:
         # present on BOTH the full and the degraded artifact).
         detail["goodput"] = _goodput_snapshot()
+        # Numerics health (docs/health.md): present on BOTH artifact
+        # shapes so bench_report.py can trend divergences/evictions and
+        # the badput they charged — a silent NaN epidemic shows up as a
+        # throughput regression; this names it.
+        detail["health"] = _health_snapshot()
         detail["telemetry"] = telemetry.snapshot()
         if detail.get("top1_miss"):
             # The accuracy clause is a GATE, not a footnote: a learning

@@ -72,7 +72,10 @@ from rafiki_tpu.obs.journal import journal as _journal
 
 ENV_VAR = "RAFIKI_CHAOS"
 
-_MODES = ("drop", "skip", "delay", "error", "kill", "term", "preempt")
+# "nan" is caller-enacted (like drop/skip/preempt): the train loops'
+# ``train.nan`` site turns a fired hook into a one-step gradient poison
+# column (ops/train.py, docs/health.md); perform() just reports it.
+_MODES = ("drop", "skip", "delay", "error", "kill", "term", "preempt", "nan")
 
 
 class ChaosError(OSError):

@@ -46,6 +46,14 @@ pack-straggler-evict           one pack member early-stops epochs before
                                state mid-pack, its slot backfilled with a
                                freshly proposed trial, and the evictee
                                bit-matches a serial early-stopped run
+nan-trial-contained            member 2 of a k=4 pack gets one step's
+                               grads NaN-poisoned: the divergence is
+                               detected at the epoch boundary, a replay
+                               capsule banked and bit-verified, the sick
+                               member evicted and ERRORED with a
+                               diagnosis, and the three survivors
+                               complete with params bit-matching
+                               unfaulted serial runs
 collective-kill-mid-step       a dp-mesh worker SIGKILLed inside the
                                collective step path; the respawn resumes
                                from checkpoint and finishes the budget
@@ -630,6 +638,115 @@ def pack_straggler_evict(tmp, check: CheckFn) -> None:
           f"scores: {[t.get('score') for t in trials]}")
     _params_match_serial(check, params, trials,
                          source=EVICT_SOURCE, cls_name="EvictFF")
+
+
+@scenario(
+    "nan-trial-contained",
+    "Chaos NaN-poisons one gradient step of pack member 2 (k=4). The "
+    "health plane must trip at the epoch boundary, bank a replay "
+    "capsule that re-executes bit-exactly, evict ONLY the sick member "
+    "(ERRORED with a diagnosis, floor score fed back), and carry the "
+    "three survivors to completion with params bit-matching unfaulted "
+    "serial runs.",
+    spec="seed=19;train.nan:nan:times=1:match=@m2",
+)
+def nan_trial_contained(tmp, check: CheckFn) -> None:
+    from rafiki_tpu import telemetry
+    from rafiki_tpu.advisor import AdvisorService
+    from rafiki_tpu.chaos import plane as plane_mod
+    from rafiki_tpu.model.base import load_model_class
+    from rafiki_tpu.model.knobs import knob_config_signature
+    from rafiki_tpu.obs import journal as journal_mod
+    from rafiki_tpu.store import MetaStore, ParamsStore
+    from rafiki_tpu.worker.train import (InProcAdvisorHandle,
+                                         PackedTrialRunner, TrainWorker)
+
+    store = MetaStore(tmp / "meta.sqlite3")
+    params = ParamsStore(tmp / "params")
+    model = store.create_model("nanff", "IMAGE_CLASSIFICATION", None,
+                               FF_SOURCE, "ChaosFF")
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": 4})
+    sub = store.get_sub_train_jobs(job["id"])[0]
+    cls = load_model_class(FF_SOURCE, "ChaosFF")
+    advisors = AdvisorService()
+    advisor_id = advisors.create_advisor(cls.get_knob_config(), kind="random")
+    worker = TrainWorker(
+        store, params, sub["id"], cls,
+        InProcAdvisorHandle(advisors, advisor_id), TRAIN, VAL,
+        {"MODEL_TRIAL_COUNT": 4}, worker_id="nan-w0", async_persist=False)
+    knob_config = cls.get_knob_config()
+    base = {"hidden_units": 16, "batch_size": 32, "epochs": 3}
+    rows = []
+    # budget_max=4 doubles as the backfill gate: the evicted slot must
+    # NOT be refilled (the budget is already fully claimed), keeping
+    # member indices stable for the @m2 match below.
+    for lr in (0.001, 0.002, 0.004, 0.008):
+        kn = dict(base, learning_rate=lr)
+        trial = store.create_trial(sub["id"], "ChaosFF", kn,
+                                   shape_sig=knob_config_signature(
+                                       knob_config, kn),
+                                   budget_max=4)
+        rows.append((trial["id"], kn))
+    n = PackedTrialRunner(worker, 4).run_assigned(rows, budget_max=4)
+    check("all_rows_carried", n == 4, f"carried {n}, want 4")
+
+    # Vacuous-pass rejection: the fault must actually have fired at the
+    # train.nan site for member 2 — a scenario that "passes" because
+    # the poison never landed proves nothing.
+    fired = [(site, mode, key)
+             for site, mode, _hit, key in plane_mod.active().schedule()
+             if site == "train.nan"]
+    check("nan_fault_fired", len(fired) == 1 and "@m2" in fired[0][2],
+          f"train.nan firings: {fired}")
+
+    trials = store.get_trials_of_train_job(job["id"])
+    check("exact_trial_rows", len(trials) == 4,
+          f"{len(trials)} rows for budget 4 (backfill must not refill "
+          "a diverged slot under a drained budget)")
+    errored = [t for t in trials if t["status"] == "ERRORED"]
+    completed = [t for t in trials if t["status"] == "COMPLETED"]
+    check("one_member_errored", len(errored) == 1,
+          f"statuses: {[t['status'] for t in trials]}")
+    check("three_survivors_completed", len(completed) == 3,
+          f"statuses: {[t['status'] for t in trials]}")
+    check("diagnosis_surfaced",
+          bool(errored) and "diverged" in (errored[0].get("error") or ""),
+          f"error: {errored[0].get('error') if errored else None}")
+    check("survivors_scored",
+          all(t.get("score") is not None for t in completed),
+          f"scores: {[t.get('score') for t in completed]}")
+    check("divergence_counted",
+          telemetry.get_counter("health.divergences") >= 1.0,
+          "no health.divergences increments")
+    check("containment_counted",
+          telemetry.get_counter("health.contained") >= 1.0,
+          "no health.contained increments")
+    check("eviction_counted",
+          telemetry.get_counter("health.evictions") >= 1.0,
+          "no health.evictions increments")
+
+    recs = journal_mod.read_dir(journal_mod.journal.log_dir)
+    check("journal_records_divergence",
+          _journal_has(recs, "health", "divergence"),
+          "no health/divergence journal record")
+    check("journal_records_capsule",
+          _journal_has(recs, "health", "capsule"),
+          "no health/capsule journal record")
+
+    # The capsule is a faithful repro: re-execute the truncated epoch
+    # and require every compared sentinel value bit-identical.
+    caps = sorted((journal_mod.journal.log_dir or tmp).glob("capsule-*.rcap"))
+    check("capsule_banked", len(caps) >= 1, "no capsule-*.rcap on disk")
+    if caps:
+        from rafiki_tpu.obs.health import capsule as capsule_mod
+
+        verdict = capsule_mod.replay(caps[-1])
+        check("capsule_replay_bit_exact", verdict["reproduced"],
+              f"mismatches: {verdict['mismatches']}")
+        check("capsule_replay_poisoned", verdict["poisoned"],
+              "replayed capsule carried no poison column")
+
+    _params_match_serial(check, params, completed)
 
 
 @scenario(

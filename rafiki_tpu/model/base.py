@@ -306,6 +306,19 @@ class JaxModel(BaseModel):
             return ds
         return Dataset(x, ds.y, ds.classes, ds.mask, ds.meta)
 
+    def _health_model_identity(self) -> Dict[str, Any]:
+        """Replay-capsule identity: what a fresh process needs to
+        re-create this template (docs/health.md). Templates loaded from
+        uploaded source embed the bytes (load_model_class stamps
+        ``__rafiki_source__`` on its scratch module)."""
+        mod = sys.modules.get(type(self).__module__)
+        return {
+            "module": type(self).__module__,
+            "qualname": type(self).__qualname__,
+            "source": getattr(mod, "__rafiki_source__", None),
+            "knobs": dict(self.knobs),
+        }
+
     def train(self, dataset_uri: str) -> None:
         from rafiki_tpu.model.log import logger
 
@@ -319,6 +332,12 @@ class JaxModel(BaseModel):
             raise ValueError(
                 f"Dataset architecture {(num_classes, input_shape)} does not match "
                 f"the loaded model {self._arch}; use a fresh model instance")
+        health = getattr(self._loop, "health", None)
+        if health is not None:
+            health.set_context(
+                model=self._health_model_identity(), train_uri=dataset_uri,
+                batch_size=self.batch_size, seed=self._seed,
+                planned_steps=getattr(self, "_planned_steps", None))
         logger.define_plot("Training", ["loss", "acc"], x_axis="epoch")
         for epoch in range(self._start_epoch, self.epochs):
             metrics = self._loop.run_epoch(ds, self.batch_size, epoch_seed=self._seed + epoch)
@@ -390,7 +409,11 @@ class JaxModel(BaseModel):
         out of the pack into a detached serial ``TrainLoop`` (so it
         still evaluates/serves/checkpoints normally and bit-matches a
         serial run) and ``on_evict(model_index, epoch, reason)`` fires
-        with reason ``"early_stop"`` or ``"finished"``. When
+        with reason ``"early_stop"`` or ``"finished"``. A member whose
+        numerics diverge (docs/health.md) leaves the same way with
+        reason ``"diverged"`` — its verdict is stashed on
+        ``model._health_verdict`` and the worker marks it errored
+        instead of scoring it. When
         ``backfill(n)`` is given it is called with the vacancy count
         and may return freshly-proposed models (same packing_key);
         they are appended to ``models``/the returned histories and
@@ -404,6 +427,7 @@ class JaxModel(BaseModel):
         (``_start_epoch > 0`` — an interrupted pack member resumes
         SERIALLY from its slice checkpoint), masked datasets.
         """
+        from rafiki_tpu.obs import health as _health
         from rafiki_tpu.ops.train import PackedTrainLoop, TrainLoop
 
         if not models:
@@ -460,6 +484,17 @@ class JaxModel(BaseModel):
 
         slots = list(range(len(models)))  # slot j <-> packed member j
         epochs_done = {mi: 0 for mi in slots}  # epochs COMPLETED so far
+        # Replay-capsule context (docs/health.md): member_info resolves
+        # a LIVE slot to its trial's knobs/seed at trip time (slots and
+        # models mutate as members leave and backfills arrive).
+        packed.health.set_context(
+            model=lead._health_model_identity(), train_uri=dataset_uri,
+            batch_size=batch_size, planned_steps=planned,
+            member_info=lambda j: {
+                "model": dict(lead._health_model_identity(),
+                              knobs=dict(models[slots[j]].knobs)),
+                "seed": models[slots[j]]._seed,
+            })
         rnd = 0
         while slots:
             # Serial parity: trial i's shuffle seed is seed_i + its OWN
@@ -480,10 +515,18 @@ class JaxModel(BaseModel):
                 on_epoch(rnd)
             rnd += 1
 
+            verdicts = getattr(packed, "last_verdicts", None) or []
             leavers = []  # (slot, model_index, just-run epoch, reason)
             for j, mi in enumerate(slots):
                 e = epochs_done[mi]
-                if e + 1 >= epochs:
+                verdict = verdicts[j] if j < len(verdicts) else None
+                if verdict is not None:
+                    # Numerics divergence (docs/health.md): the member
+                    # leaves NOW regardless of budget — its verdict
+                    # rides on the model for the worker's diagnosis.
+                    models[mi]._health_verdict = verdict
+                    leavers.append((j, mi, e, "diverged"))
+                elif e + 1 >= epochs:
                     leavers.append((j, mi, e, "finished"))
                 elif models[mi].should_stop_early(e, mts[j]):
                     leavers.append((j, mi, e, "early_stop"))
@@ -499,7 +542,10 @@ class JaxModel(BaseModel):
                     m._loop = packed.slice(j)
                     m._arch = arch
                     m._epochs_done = e
-                    if on_evict is not None and reason == "early_stop":
+                    if reason == "diverged":
+                        _health.note_eviction()
+                    if on_evict is not None and reason in ("early_stop",
+                                                           "diverged"):
                         on_evict(mi, e, reason)
                 break
 
@@ -508,6 +554,8 @@ class JaxModel(BaseModel):
             for j, mi, e, reason in sorted(leavers, reverse=True):
                 install_detached(mi, packed.evict(j), e)
                 slots.pop(j)
+                if reason == "diverged":
+                    _health.note_eviction()
                 if on_evict is not None:
                     on_evict(mi, e, reason)
 
@@ -757,6 +805,9 @@ def load_model_class(model_file_bytes: bytes, model_class: str,
     except Exception:
         del sys.modules[name]
         raise
+    # Health replay capsules (docs/health.md) embed the source so a
+    # fresh process can rebuild the class without this scratch module.
+    mod.__rafiki_source__ = model_file_bytes
     if not hasattr(mod, model_class):
         del sys.modules[name]
         raise ValueError(f"Model file defines no class named {model_class!r}")

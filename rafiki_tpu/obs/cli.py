@@ -15,6 +15,15 @@ Subcommands (all read ``journal-*.jsonl*`` under ``--dir``, default
                    key hash or substring-matches the key repr
     slo            current SLO burn state (latest ``slo/state``) plus
                    the breach/recovery history
+    health         numerics health: every ``health/divergence`` verdict
+                   with its diagnosis and capsule, plus totals
+                   (docs/health.md)
+    curves [id]    per-trial learning curves from the durable
+                   ``trial/epoch_eval`` records; ``id`` prefix-matches
+                   trial ids (omit for every trial)
+    replay <cap>   re-execute a divergence capsule and bit-verify the
+                   reproduction; exit 0 iff the bad step reproduced
+                   bit-exactly
 
 Output is one human line per record by default, ``--json`` for JSONL
 (pipe into jq). Exit code 1 when a requested trace has no records.
@@ -221,6 +230,126 @@ def cmd_slo(log_dir: str, as_json: bool) -> int:
     return 0
 
 
+def cmd_health(log_dir: str, as_json: bool) -> int:
+    """Numerics health report: divergence verdicts + capsule inventory
+    from the ``health/*`` journal records (docs/health.md). An empty
+    report is a PASS — exit 0 with a clean bill, unlike trace/curves
+    where absence means the query missed."""
+    records = journal_mod.read_dir(log_dir)
+    divergences: List[Dict[str, Any]] = []
+    capsules: List[Dict[str, Any]] = []
+    errors: List[Dict[str, Any]] = []
+    for r in records:
+        if r.get("kind") != "health":
+            continue
+        if r.get("name") == "divergence":
+            divergences.append(r)
+        elif r.get("name") == "capsule":
+            capsules.append(r)
+        elif r.get("name") == "capsule_error":
+            errors.append(r)
+    if as_json:
+        print(json.dumps({"divergences": divergences, "capsules": capsules,
+                          "capsule_errors": errors}, default=str))
+        return 0
+    if not divergences and not errors:
+        print(f"no divergences under {log_dir} — numerically clean")
+        return 0
+    print(f"divergences: {len(divergences)}, capsules: {len(capsules)}, "
+          f"capsule write errors: {len(errors)}")
+    for d in divergences:
+        member = d.get("member")
+        where = f" member={member}" if member is not None else ""
+        cap = d.get("capsule")
+        print(f"  ts={d.get('ts')} {d.get('divergence', '?'):<10}"
+              f"{where} bad_step={d.get('bad_step')} "
+              f"badput={d.get('badput_s')}s")
+        print(f"    {d.get('diagnosis', '?')}")
+        if cap:
+            print(f"    capsule: {cap}")
+    for e in errors:
+        print(f"  capsule write FAILED: {e.get('error')}")
+    return 0
+
+
+def cmd_curves(log_dir: str, trial: Optional[str], as_json: bool) -> int:
+    """Learning-curve surfacing: replay the durable ``trial/epoch_eval``
+    records into per-trial curves (the journal half of what the sqlite
+    trial log holds per process)."""
+    curves: Dict[str, List[Dict[str, Any]]] = {}
+    for r in journal_mod.read_dir(log_dir):
+        if r.get("kind") != "trial" or r.get("name") != "epoch_eval":
+            continue
+        tid = str(r.get("trial_id", "?"))
+        if trial and not tid.startswith(trial):
+            continue
+        curves.setdefault(tid, []).append(r)
+    if not curves:
+        print(f"no epoch_eval records"
+              f"{f' for trial {trial!r}' if trial else ''} under {log_dir}",
+              file=sys.stderr)
+        return 1
+    for tid in curves:
+        curves[tid].sort(key=lambda r: (r.get("epoch", 0), r.get("ts", 0.0)))
+    if as_json:
+        print(json.dumps({"trials": curves}, default=str))
+        return 0
+    for tid, rows in sorted(curves.items()):
+        last = rows[-1]
+        packed = " [packed]" if last.get("packed") else ""
+        print(f"trial {tid}{packed}: {len(rows)} epochs, "
+              f"final score={last.get('score')}")
+        for r in rows:
+            vals = []
+            for k in ("loss", "acc"):
+                if r.get(k) is not None:
+                    vals.append(f"{k}={r[k]:.6g}")
+            if r.get("wall_s") is not None:
+                vals.append(f"wall={r['wall_s']:.3f}s")
+            print(f"  epoch {r.get('epoch'):>3}  " + " ".join(vals))
+    return 0
+
+
+def cmd_replay(path: str, as_json: bool) -> int:
+    """Re-execute a divergence capsule and report the bit-comparison.
+    Exit 0 only when every compared sentinel value reproduced exactly —
+    the determinism contract scripts/health_smoke.py enforces."""
+    from rafiki_tpu.obs.health import capsule
+
+    try:
+        result = capsule.replay(path)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"replay failed: {e}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(result, default=str))
+        return 0 if result["reproduced"] else 1
+    member = result.get("member")
+    where = f" member={member}" if member is not None else ""
+    print(f"capsule {result['capsule']}: {result['kind']}{where} "
+          f"bad_step={result['bad_step']} "
+          f"steps_replayed={result['steps_replayed']}"
+          + (" (poisoned)" if result["poisoned"] else ""))
+    for k, c in result["comparisons"].items():
+        mark = "ok " if c["match"] else "DIFF"
+        bits = (f" [{c['expected_bits']} vs {c['got_bits']}]"
+                if "expected_bits" in c else "")
+        print(f"  {mark} {k:<26} expected={c['expected']} "
+              f"got={c['got']}{bits}")
+    if result["reproduced"]:
+        print("reproduced: the divergent step re-executed bit-exactly")
+        return 0
+    print(f"NOT reproduced: {', '.join(result['mismatches'])} diverged "
+          f"from the observed run — the failure is not deterministic "
+          f"under replay (docs/health.md#non-reproducing-capsules)")
+    cap_env = result.get("captured_env") or {}
+    rep_env = result.get("replay_env") or {}
+    if cap_env != rep_env:
+        print(f"  note: captured on {cap_env}, replayed on {rep_env} — "
+              f"a build/backend mismatch changes XLA fusion and rounding")
+    return 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     from rafiki_tpu.utils.backend import honor_env_platform
 
@@ -248,8 +377,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp.add_argument("--peak-flops", type=float, default=None,
                     help="MFU denominator (default: v5e bf16 peak)")
     sub.add_parser("slo", help="current SLO burn state + breach history")
+    sub.add_parser("health",
+                   help="numerics divergences + replay capsule inventory")
+    sp = sub.add_parser("curves",
+                        help="per-trial learning curves from the journals")
+    sp.add_argument("trial", nargs="?", default=None,
+                    help="trial id prefix (omit for all trials)")
+    sp = sub.add_parser("replay",
+                        help="re-execute a divergence capsule, bit-verify")
+    sp.add_argument("capsule", help="path to a capsule-*.rcap file")
     args = p.parse_args(argv)
 
+    if args.cmd == "replay":
+        # No journal dir needed: the capsule is self-contained.
+        return cmd_replay(args.capsule, args.json)
     log_dir = args.dir or _default_dir()
     if args.cmd == "trace":
         return cmd_trace(log_dir, args.trace_id, args.json)
@@ -259,4 +400,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_profile(log_dir, args.key, args.json, args.peak_flops)
     if args.cmd == "slo":
         return cmd_slo(log_dir, args.json)
+    if args.cmd == "health":
+        return cmd_health(log_dir, args.json)
+    if args.cmd == "curves":
+        return cmd_curves(log_dir, args.trial, args.json)
     return cmd_slowest(log_dir, args.n, args.json)

@@ -1,0 +1,42 @@
+"""Numerics health plane (docs/health.md).
+
+Three layers, one per module:
+
+* ``sentinel``  — the in-graph half: a cheap per-step health bundle
+  (loss finiteness, grad/update/param norms, non-finite counts) folded
+  into the jitted donated epoch programs, reduced on-device, fetched
+  once per epoch. Pure jax; imported lazily so this package stays
+  importable before the backend is pinned.
+* ``detector``  — the host half: per-trial divergence detection
+  (NaN/Inf trips immediately, grad-norm explosion trips with
+  hysteresis), journaling, badput charging, flight records, and the
+  :class:`DivergenceError` contract serial workers fail fast on.
+* ``capsule``   — replay capsules: atomic dumps of the pre-epoch state
+  + offending batch ids, re-executed and bit-verified by
+  ``python -m rafiki_tpu.obs replay <capsule>``.
+
+The ``health`` telemetry collector (divergences / capsules / evictions
+/ contained / badput charged) registers on import; ``ops.train``
+imports this package, so the collector is live wherever training is.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from rafiki_tpu import telemetry
+from rafiki_tpu.obs.health.detector import (  # noqa: F401
+    DEFAULT_HYSTERESIS, DEFAULT_K, DEFAULT_WARMUP, ENV_CAPSULE, ENV_ENABLE,
+    ENV_HYSTERESIS, ENV_K, ENV_WARMUP, DivergenceError, HealthMonitor,
+    note_contained, note_eviction, reset_stats, stats)
+
+telemetry.register_collector("health", stats)
+
+
+def __getattr__(name: str):
+    # sentinel/capsule import jax at module scope; loading them lazily
+    # keeps `import rafiki_tpu.obs.health` safe before
+    # honor_env_platform() has pinned the backend.
+    if name in ("sentinel", "capsule"):
+        return importlib.import_module(f"rafiki_tpu.obs.health.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

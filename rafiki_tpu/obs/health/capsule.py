@@ -1,0 +1,233 @@
+"""Divergence replay capsules: freeze the bad step, re-execute it, and
+verify bit-reproduction.
+
+A capsule is an atomically-written pickle (``capsule-<pid>-<seq>.rcap``
+in the journal directory) banked by the HealthMonitor at trip time. It
+carries everything a fresh process needs to re-run the divergent epoch
+prefix deterministically:
+
+* the full train state ``(params, opt_state, step, rng, hyper)`` as it
+  was BEFORE the bad epoch (packed members are sliced to serial shape —
+  the pack invariant makes the serial re-execution bit-identical),
+  serialized with ``utils.serial.dump_pytree`` at full precision;
+* the offending batch-id rows (the epoch's shuffled index matrix,
+  truncated at the first bad step) and the chaos poison column, if any
+  (an injected fault must be re-applied for the replay to reproduce);
+* the model's identity — import path, knobs, and the uploaded source
+  bytes when it was loaded via ``load_model_class`` — plus the train
+  dataset URI and batch size.
+
+``python -m rafiki_tpu.obs replay <capsule>`` rebuilds the model,
+restores the state, re-runs the truncated epoch through the SAME jitted
+program and compares the at-bad-step sentinel values bit-for-bit
+(f32 payloads compared as uint32 views; NaNs compare equal at the bit
+level). Exit 0 means the divergence is deterministic and the capsule is
+a faithful repro; anything else is itself a finding (docs/health.md).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+VERSION = 1
+SUFFIX = ".rcap"
+
+#: The sentinel keys replay must reproduce bit-exactly for every
+#: capsule kind. ``bad_*`` values are taken AT the first bad step
+#: (step 0 for a clean/explosion epoch), so they are well-defined for
+#: truncated and full-epoch replays alike.
+_ALWAYS_KEYS = ("health_bad_step", "health_bad_nonfinite",
+                "health_bad_grad_norm", "health_bad_update_norm")
+#: Extra keys compared when the replay covers the FULL epoch
+#: (explosion capsules, bad_step < 0): whole-epoch reductions only
+#: match when the replayed step count matches the observed one.
+_FULL_EPOCH_KEYS = ("health_grad_norm", "health_update_norm",
+                    "health_param_norm", "health_nonfinite")
+_INT_KEYS = ("health_bad_step", "health_bad_nonfinite", "health_nonfinite")
+
+
+def f32_bits(x: float) -> int:
+    """The uint32 bit pattern of ``x`` as an f32 — the equality domain
+    for replay verification (float() round-trips f32 exactly, and NaN
+    bit patterns compare equal where NaN floats would not)."""
+    return int(np.float32(x).view(np.uint32))
+
+
+def _resolve_dir() -> Optional[Path]:
+    from rafiki_tpu.obs.journal import ENV_VAR, journal
+
+    d = journal.log_dir or os.environ.get(ENV_VAR)
+    return Path(d) if d else None
+
+
+def write(monitor: Any, *, member: Optional[int], kind: str,
+          health: Dict[str, float], epoch_seed: Optional[int], idx: Any,
+          poison: Any, state: Any, seq: int) -> Optional[Path]:
+    """Bank one capsule; returns its path or None (no journal dir /
+    no model context). Called from the HealthMonitor trip path, which
+    guards with try/except — a capsule failure never kills training."""
+    ctx = monitor._member_ctx(member)
+    model = ctx.get("model")
+    d = _resolve_dir()
+    if d is None or not model:
+        return None
+    from rafiki_tpu.utils.serial import dump_pytree
+
+    bad_step = int(health.get("health_bad_step", -1))
+    if idx is not None:
+        idx = np.asarray(idx, np.int32)
+        if bad_step >= 0:
+            idx = idx[: bad_step + 1]
+    if poison is not None:
+        poison = np.asarray(poison, np.float32)
+        if bad_step >= 0:
+            poison = poison[: bad_step + 1]
+    import jax
+
+    payload = {
+        "version": VERSION,
+        "created_ts": time.time(),
+        # Capture-environment fingerprint: replay compares builds, not
+        # just bits, when diagnosing a non-reproducing capsule
+        # (docs/health.md#non-reproducing-capsules).
+        "platform": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "kind": kind,
+        "perf_key": monitor.key,
+        "member": member,
+        "packed": member is not None,
+        "bad_step": bad_step,
+        "observed": {k: float(v) for k, v in health.items()},
+        "epoch_seed": None if epoch_seed is None else int(epoch_seed),
+        "idx": idx,
+        "poison": poison,
+        "state_packed": dump_pytree(state, cast_f32_to_bf16=False),
+        "model": dict(model),
+        "train_uri": ctx.get("train_uri"),
+        "batch_size": ctx.get("batch_size"),
+        "seed": ctx.get("seed", 0),
+        "planned_steps": ctx.get("planned_steps"),
+    }
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / f"capsule-{os.getpid()}-{seq}{SUFFIX}"
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+    from rafiki_tpu.obs.journal import journal
+
+    journal.record("health", "capsule", path=str(path), divergence=kind,
+                   member=member, bad_step=bad_step)
+    return path
+
+
+def load(path: str | os.PathLike) -> Dict[str, Any]:
+    with open(path, "rb") as f:
+        cap = pickle.load(f)
+    if not isinstance(cap, dict) or cap.get("version") != VERSION:
+        raise ValueError(f"{path}: not a v{VERSION} rafiki health capsule")
+    return cap
+
+
+def _rebuild_model(cap: Dict[str, Any]):
+    """Re-instantiate the diverged trial's model template: from the
+    embedded uploaded source when it was a ``load_model_class`` model,
+    else by ordinary import of the recorded module path."""
+    m = cap["model"]
+    if m.get("source"):
+        from rafiki_tpu.model.base import load_model_class
+
+        cls = load_model_class(m["source"], m["qualname"].split(".")[0])
+    else:
+        import functools
+        import importlib
+
+        mod = importlib.import_module(m["module"])
+        cls = functools.reduce(getattr, m["qualname"].split("."), mod)
+    return cls(**(m.get("knobs") or {}))
+
+
+def replay(path: str | os.PathLike) -> Dict[str, Any]:
+    """Re-execute a capsule's divergent epoch prefix and bit-compare
+    the sentinel surface. Returns a verdict document (JSON-able)."""
+    import jax
+    import jax.numpy as jnp
+    from flax import serialization
+
+    cap = load(path)
+    model = _rebuild_model(cap)
+    ds = model._prepared_dataset(cap["train_uri"])
+    num_classes, input_shape = model._dataset_arch(ds)
+    if cap.get("planned_steps"):
+        model._planned_steps = cap["planned_steps"]
+    model._build_loop(num_classes, input_shape)
+    loop = model._loop
+
+    from rafiki_tpu.ops.train import get_device_dataset
+    from rafiki_tpu.utils.serial import load_pytree
+
+    template = loop.state
+    raw = load_pytree(cap["state_packed"])
+    state = serialization.from_state_dict(template, raw)
+    state = jax.tree.map(
+        lambda t, v: jnp.asarray(v, jnp.asarray(t).dtype), template, state)
+
+    X, Y = get_device_dataset(ds)
+    idx = cap.get("idx")
+    if idx is None:
+        raise ValueError(f"{path}: capsule carries no batch indices "
+                         "(trial ran outside the device-resident fast "
+                         "path); replay is not supported")
+    idx = jnp.asarray(np.asarray(idx, np.int32))
+    poison = cap.get("poison")
+    if poison is not None:
+        poison = jnp.asarray(np.asarray(poison, np.float32))
+    _, metrics = loop.program.train_epoch(jax.device_put(state), X, Y,
+                                          idx, poison)
+    got = {k: float(v) for k, v in metrics.items()
+           if k.startswith("health_")}
+
+    expected = cap["observed"]
+    keys = list(_ALWAYS_KEYS)
+    if cap["bad_step"] < 0:
+        keys += list(_FULL_EPOCH_KEYS)
+    mismatches = []
+    comparisons = {}
+    for k in keys:
+        if k in _INT_KEYS:
+            e, g = int(expected[k]), int(got[k])
+            ok = e == g
+            comparisons[k] = {"expected": e, "got": g, "match": ok}
+        else:
+            e, g = f32_bits(expected[k]), f32_bits(got[k])
+            ok = e == g
+            comparisons[k] = {"expected": float(np.float32(expected[k])),
+                              "got": float(np.float32(got[k])),
+                              "expected_bits": f"{e:08x}",
+                              "got_bits": f"{g:08x}", "match": ok}
+        if not ok:
+            mismatches.append(k)
+    return {
+        "capsule": str(path),
+        "kind": cap["kind"],
+        "bad_step": cap["bad_step"],
+        "member": cap.get("member"),
+        "steps_replayed": int(idx.shape[0]),
+        "poisoned": poison is not None,
+        # Environment fingerprints: a NOT-reproduced verdict across
+        # differing builds is expected, not alarming
+        # (docs/health.md#non-reproducing-capsules).
+        "captured_env": {"platform": cap.get("platform"),
+                         "jax_version": cap.get("jax_version")},
+        "replay_env": {"platform": jax.default_backend(),
+                       "jax_version": jax.__version__},
+        "comparisons": comparisons,
+        "reproduced": not mismatches,
+        "mismatches": mismatches,
+    }

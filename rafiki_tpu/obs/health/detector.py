@@ -1,0 +1,335 @@
+"""Divergence detection: the host half of the numerics health plane.
+
+A :class:`HealthMonitor` lives on each TrainLoop / PackedTrainLoop and
+consumes the epoch-boundary sentinel scalars (obs/health/sentinel.py).
+Two trip conditions per trial:
+
+* **nonfinite** — any non-finite gradient/loss element this epoch (or a
+  non-finite global grad norm). Trips immediately: NaNs never heal.
+* **explosion** — the epoch's max grad norm exceeds ``RAFIKI_HEALTH_K``
+  times the trial's running median for ``RAFIKI_HEALTH_HYSTERESIS``
+  consecutive epochs, after ``RAFIKI_HEALTH_WARMUP`` clean epochs of
+  history. Exploded samples are NOT absorbed into the median, so a slow
+  ramp cannot normalize itself out of detection.
+
+On trip the monitor journals ``health/divergence``, bumps
+``health.divergences``, charges the trial's banked wall-clock to the
+``badput_s`` ledger bucket, dumps a flight record, and (when a
+pre-epoch state snapshot is available) writes a replay capsule
+(obs/health/capsule.py). Serial loops then raise
+:class:`DivergenceError` so the worker fails the trial fast with a
+diagnosis; packed loops return per-member verdicts and the pack driver
+evicts only the sick member (docs/health.md).
+
+This module is import-light on purpose (stdlib + telemetry + journal +
+ledger): it must be importable before the jax backend is pinned.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import statistics
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from rafiki_tpu import telemetry
+from rafiki_tpu.obs.journal import journal
+from rafiki_tpu.obs.ledger import ledger
+
+#: Kill switch for the whole plane ("0"/"off" disables detection AND
+#: capsules; the in-graph bundle still runs — it is part of the trace).
+ENV_ENABLE = "RAFIKI_HEALTH"
+#: Grad-norm explosion multiplier over the trial's running median.
+ENV_K = "RAFIKI_HEALTH_K"
+#: Clean epochs of history required before the explosion arm is live.
+ENV_WARMUP = "RAFIKI_HEALTH_WARMUP"
+#: Consecutive exploding epochs required to trip (nonfinite ignores this).
+ENV_HYSTERESIS = "RAFIKI_HEALTH_HYSTERESIS"
+#: "0"/"off" skips the pre-epoch state snapshot + capsule writes while
+#: keeping detection/containment live.
+ENV_CAPSULE = "RAFIKI_HEALTH_CAPSULE"
+
+DEFAULT_K = 50.0
+DEFAULT_WARMUP = 3
+DEFAULT_HYSTERESIS = 2
+_HISTORY = 32
+
+_STATS: Dict[str, float] = {"divergences": 0, "capsules": 0, "evictions": 0,
+                            "contained": 0, "badput_charged_s": 0.0}
+
+
+def stats() -> Dict[str, float]:
+    """The ``health`` telemetry collector payload (process-wide)."""
+    out = dict(_STATS)
+    out["badput_charged_s"] = round(float(out["badput_charged_s"]), 6)
+    return out
+
+
+def reset_stats() -> None:
+    for k in _STATS:
+        _STATS[k] = 0.0 if k == "badput_charged_s" else 0
+
+
+def note_eviction() -> None:
+    """A pack member was evicted for divergence (model/base.py)."""
+    _STATS["evictions"] += 1
+    telemetry.inc("health.evictions")
+
+
+def note_contained() -> None:
+    """A diverged trial was contained by the worker (fail-fast or
+    packed skip-and-score-survivors) instead of burning its budget."""
+    _STATS["contained"] += 1
+    telemetry.inc("health.contained")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _on(name: str) -> bool:
+    return os.environ.get(name, "1").strip().lower() not in (
+        "0", "off", "false", "no")
+
+
+class DivergenceError(RuntimeError):
+    """A serial trial's numerics diverged; carries the verdict dict
+    (kind/bad_step/diagnosis/capsule path) for the worker to surface."""
+
+    def __init__(self, verdict: Dict[str, Any]):
+        super().__init__(verdict.get("diagnosis", "numerics diverged"))
+        self.verdict = verdict
+
+
+class _MemberState:
+    __slots__ = ("history", "streak", "bank", "tripped")
+
+    def __init__(self) -> None:
+        self.history: deque = deque(maxlen=_HISTORY)
+        self.streak = 0
+        self.bank = 0.0  # wall-clock this trial has consumed so far
+        self.tripped = False
+
+
+class HealthMonitor:
+    """Per-loop divergence detector. ``k=0`` is a serial loop (one
+    member); ``k>0`` mirrors a pack's live width through
+    :meth:`evict_member` / :meth:`admit_member`."""
+
+    def __init__(self, key: str, k: int = 0):
+        self.key = str(key)
+        self.k = int(k)
+        self._members: List[_MemberState] = [
+            _MemberState() for _ in range(max(1, self.k))]
+        self._ctx: Optional[Dict[str, Any]] = None
+        self._seq = 0
+        self.enabled = _on(ENV_ENABLE)
+        self.capsules_enabled = self.enabled and _on(ENV_CAPSULE)
+        self.explosion_k = _env_float(ENV_K, DEFAULT_K)
+        self.warmup = max(1, _env_int(ENV_WARMUP, DEFAULT_WARMUP))
+        self.hysteresis = max(1, _env_int(ENV_HYSTERESIS, DEFAULT_HYSTERESIS))
+
+    # -- wiring --------------------------------------------------------------
+
+    def set_context(self, **ctx: Any) -> None:
+        """Replay context from the model layer: ``model`` identity dict
+        (module/qualname/source/knobs), ``train_uri``, ``batch_size``,
+        ``seed``, ``planned_steps``; packed packs pass ``member_info``,
+        a ``slot -> {knobs, seed}`` callable resolved at trip time."""
+        self._ctx = dict(self._ctx or {}, **ctx)
+
+    def _member_ctx(self, member: Optional[int]) -> Dict[str, Any]:
+        ctx = dict(self._ctx or {})
+        info = ctx.pop("member_info", None)
+        if member is not None and callable(info):
+            try:
+                ctx.update(info(member) or {})
+            except Exception:
+                pass  # a stale slot must not break the trip path
+        return ctx
+
+    def evict_member(self, i: int) -> None:
+        if self.k > 0 and 0 <= i < len(self._members):
+            self._members.pop(i)
+            self.k -= 1
+
+    def admit_member(self) -> None:
+        self._members.append(_MemberState())
+        self.k += 1
+
+    # -- pre-epoch snapshot --------------------------------------------------
+
+    def snapshot_state(self, state: Any) -> Any:
+        """Host copy of the train state BEFORE the epoch dispatches:
+        the epoch programs donate their input buffers, so the capsule's
+        'state at the start of the bad epoch' must be banked up front.
+        Returns None when capsules are off (no copy, no sync)."""
+        if not self.capsules_enabled:
+            return None
+        import jax
+
+        return jax.device_get(state)
+
+    # -- observation ---------------------------------------------------------
+
+    def observe(self, health: Dict[str, float], *, t0: Optional[float] = None,
+                epoch_seed: Optional[int] = None, idx: Any = None,
+                poison: Any = None, snapshot: Any = None
+                ) -> Optional[Dict[str, Any]]:
+        """Serial epoch boundary: returns a verdict dict on trip, else
+        None. The caller (TrainLoop) raises DivergenceError on it."""
+        return self._observe(0, health, t0=t0, epoch_seed=epoch_seed,
+                             idx=idx, poison=poison, member_state=snapshot,
+                             member=None)
+
+    def observe_pack(self, health_rows: List[Dict[str, float]], *,
+                     t0: Optional[float] = None,
+                     epoch_seeds: Any = None, idx: Any = None,
+                     poison: Any = None, snapshot: Any = None
+                     ) -> List[Optional[Dict[str, Any]]]:
+        """Packed epoch boundary: one Optional[verdict] per live member.
+        ``idx``/``poison`` are the (n_steps, k, ...) epoch arrays; the
+        snapshot is the stacked pre-epoch host state (sliced per sick
+        member only on trip)."""
+        verdicts: List[Optional[Dict[str, Any]]] = []
+        for j, health in enumerate(health_rows):
+            member_state = None
+            if snapshot is not None and self._would_trip(j, health):
+                import jax
+
+                member_state = jax.tree.map(
+                    lambda a: a[j] if getattr(a, "ndim", 0) else a, snapshot)
+            verdicts.append(self._observe(
+                j, health, t0=t0,
+                epoch_seed=(epoch_seeds[j] if epoch_seeds is not None else None),
+                idx=(idx[:, j] if idx is not None else None),
+                poison=(poison[:, j] if poison is not None else None),
+                member_state=member_state, member=j))
+        return verdicts
+
+    def _classify(self, st: _MemberState,
+                  health: Dict[str, float]) -> Optional[str]:
+        """Pure trip decision against CURRENT detector state; does not
+        mutate. 'explosion' means the streak including this epoch would
+        reach the hysteresis bar."""
+        gn = float(health.get("health_grad_norm", 0.0))
+        nf = int(health.get("health_nonfinite", 0))
+        if nf > 0 or not math.isfinite(gn):
+            return "nonfinite"
+        if len(st.history) >= self.warmup:
+            median = statistics.median(st.history)
+            if median > 0.0 and gn > self.explosion_k * median:
+                if st.streak + 1 >= self.hysteresis:
+                    return "explosion"
+        return None
+
+    def _would_trip(self, j: int, health: Dict[str, float]) -> bool:
+        if not self.enabled or not health:
+            return False
+        st = self._members[j]
+        return (not st.tripped) and self._classify(st, health) is not None
+
+    def _observe(self, j: int, health: Dict[str, float], *, t0, epoch_seed,
+                 idx, poison, member_state, member
+                 ) -> Optional[Dict[str, Any]]:
+        if not self.enabled or not health:
+            return None
+        st = self._members[j]
+        if t0 is not None:
+            # This module is telemetry-adjacent plumbing (obs/ is exempt
+            # from the RF007 monotonic-delta rule): the bank is the
+            # wall-clock a divergence retroactively turns into badput.
+            st.bank += (time.monotonic() - t0) / max(1, self.k or 1)
+        if st.tripped:
+            return None
+        kind = self._classify(st, health)
+        gn = float(health.get("health_grad_norm", 0.0))
+        if kind is None:
+            if (len(st.history) >= self.warmup
+                    and statistics.median(st.history) > 0.0
+                    and gn > self.explosion_k * statistics.median(st.history)):
+                st.streak += 1  # above the bar but under the hysteresis
+            else:
+                st.streak = 0
+                st.history.append(gn)
+            return None
+        return self._trip(st, kind, health, epoch_seed=epoch_seed, idx=idx,
+                          poison=poison, member_state=member_state,
+                          member=member)
+
+    # -- the trip path -------------------------------------------------------
+
+    def _diagnosis(self, kind: str, st: _MemberState,
+                   health: Dict[str, float]) -> str:
+        gn = float(health.get("health_grad_norm", float("nan")))
+        if kind == "nonfinite":
+            return (f"non-finite numerics at step "
+                    f"{int(health.get('health_bad_step', -1))}: "
+                    f"{int(health.get('health_nonfinite', 0))} bad elements, "
+                    f"grad_norm={gn:.4g}")
+        median = statistics.median(st.history) if st.history else 0.0
+        return (f"grad-norm explosion: {gn:.4g} > {self.explosion_k:g}x "
+                f"running median {median:.4g} "
+                f"({self.hysteresis} consecutive epochs)")
+
+    def _trip(self, st: _MemberState, kind: str, health: Dict[str, float], *,
+              epoch_seed, idx, poison, member_state, member
+              ) -> Dict[str, Any]:
+        st.tripped = True
+        bad_step = int(health.get("health_bad_step", -1))
+        capsule_path = None
+        if self.capsules_enabled and member_state is not None and self._ctx:
+            try:
+                from rafiki_tpu.obs.health import capsule as capsule_mod
+
+                capsule_path = capsule_mod.write(
+                    self, member=member, kind=kind, health=health,
+                    epoch_seed=epoch_seed, idx=idx, poison=poison,
+                    state=member_state, seq=self._seq)
+                self._seq += 1
+            except Exception as e:  # capsules must never kill training
+                journal.record("health", "capsule_error", key=self.key,
+                               error=f"{type(e).__name__}: {e}")
+        if capsule_path is not None:
+            _STATS["capsules"] += 1
+            telemetry.inc("health.capsules")
+        wasted = st.bank
+        if wasted > 0.0:
+            # The trial's whole wall so far is retroactively badput: the
+            # epochs "succeeded" but computed garbage. Overlaps the
+            # step_s/compile_s charges by design — same convention as
+            # chaos-injected downtime_s (docs/observability.md).
+            ledger.add("badput_s", wasted)
+            _STATS["badput_charged_s"] += wasted
+        _STATS["divergences"] += 1
+        telemetry.inc("health.divergences")
+        verdict = {
+            "divergence": kind,
+            "key": self.key,
+            "member": member,
+            "bad_step": bad_step,
+            "grad_norm": float(health.get("health_grad_norm", float("nan"))),
+            "update_norm": float(health.get("health_update_norm",
+                                            float("nan"))),
+            "nonfinite": int(health.get("health_nonfinite", 0)),
+            "badput_s": round(wasted, 6),
+            "capsule": str(capsule_path) if capsule_path else None,
+            "diagnosis": self._diagnosis(kind, st, health),
+        }
+        journal.record("health", "divergence", **verdict)
+        from rafiki_tpu.obs import recorder
+
+        recorder.dump("health:divergence", extra={"health": verdict})
+        return verdict

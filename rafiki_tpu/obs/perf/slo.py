@@ -104,6 +104,9 @@ def default_specs() -> List[SloSpec]:
                 description="downtime under 10% of wall"),
         SloSpec("step_anomaly_rate", "counter:perf.anomalies", 0.05,
                 description="step-time anomalies under 3/min sustained"),
+        SloSpec("divergence_rate", "counter:health.divergences", 0.02,
+                description="numerics divergences under ~1/min sustained "
+                            "(docs/health.md)"),
     ]
 
 

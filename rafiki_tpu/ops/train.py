@@ -40,6 +40,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from rafiki_tpu import telemetry
+from rafiki_tpu.obs.health import DivergenceError, HealthMonitor
+from rafiki_tpu.obs.health import sentinel as _sentinel
 
 Batch = Dict[str, np.ndarray]
 Params = Any
@@ -164,15 +166,29 @@ def _make_step_fns(init_fn, apply_fn, loss_fn: LossFn,
 
     def train_step(state, batch):
         params, opt_state, step_i, rng, hyper = state
+        batch = dict(batch)
+        poison = batch.pop("_health_poison", None)
         rng, sub = jax.random.split(rng)
         (loss, metrics), grads = jax.value_and_grad(loss4, has_aux=True)(
             params, batch, sub, hyper)
+        if poison is not None:
+            # Chaos ``train.nan`` carrier (docs/chaos.md): the poison is
+            # a per-step f32 multiplier, 1.0 everywhere except the
+            # target step (NaN). Multiply-by-1.0 is IEEE bit-exact, so
+            # unpoisoned steps — and unpoisoned pack members, whose
+            # whole column is ones — stay bit-identical to a clean run.
+            grads = jax.tree.map(lambda g: g * poison.astype(g.dtype), grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         if dynamic_lr:
             lr = effective_lr(hyper, step_i)
             updates = jax.tree.map(lambda u: (-lr).astype(u.dtype) * u, updates)
         params = optax.apply_updates(params, updates)
-        metrics = dict(metrics, loss=loss)
+        # Health sentinels ride the metric dict as device scalars —
+        # unconditionally, so every cached program shares one trace and
+        # one metric structure; they read the step's intermediates but
+        # never touch the rng chain or the update math (bit-neutral).
+        metrics = dict(metrics, loss=loss,
+                       **_sentinel.bundle(loss, grads, updates, params))
         return (params, opt_state, step_i + 1, rng, hyper), metrics
 
     def eval_step(params, batch):
@@ -232,16 +248,27 @@ class Program:
         # host<->device link the per-step feed dominates the step
         # itself; on real hardware this still removes n_steps dispatch
         # round-trips per epoch.
-        def train_epoch(state, X, Y, idx):
-            def body(st, ib):
+        def train_epoch(state, X, Y, idx, poison=None):
+            # ``poison`` is the optional (n_steps,) chaos train.nan
+            # column; None (a leafless scan xs node) and array calls
+            # are two separate traces of one Program, so clean runs
+            # never carry the poison multiply.
+            def body(st, xs):
+                ib, pz = xs
                 batch = {"x": jnp.take(X, ib, axis=0),
                          "y": jnp.take(Y, ib, axis=0)}
+                if pz is not None:
+                    batch["_health_poison"] = pz
                 return train_step(st, batch)
 
-            state, ms = jax.lax.scan(body, state, idx)
+            state, ms = jax.lax.scan(body, state, (idx, poison))
             # Final-step metrics are the epoch result (parity with the
-            # python-loop path).
-            return state, {k: v[-1] for k, v in ms.items()}
+            # python-loop path); the health series reduces on-device to
+            # its epoch-boundary summary (docs/health.md).
+            rest, health = _sentinel.split(ms)
+            out = {k: v[-1] for k, v in rest.items()}
+            out.update(_sentinel.reduce_epoch(health))
+            return state, out
 
         def eval_epoch(params, X, Y, idx):
             def body(carry, ib):
@@ -481,6 +508,10 @@ class TrainLoop:
         self.plan = self.program.plan
         self.apply_fn = apply_fn
         self.optimizer = self.program.optimizer
+        # Numerics health plane (docs/health.md): consumes the in-graph
+        # sentinel scalars at each epoch boundary; serial loops fail
+        # fast (DivergenceError) on divergence.
+        self.health = HealthMonitor(str(self._perf_key))
         # Back-compat aliases (bench/tests poke the private names).
         self._train_step = self.program.train_step
         self._eval_step = self.program.eval_step
@@ -538,6 +569,15 @@ class TrainLoop:
             _chaos.hook("collective.step",
                         key=f"p{jax.process_index()}:"
                             f"{_os.environ.get('RAFIKI_WORKER_ID', '')}")
+        fast = on_metrics is None and self._fits_device_fast_path(dataset)
+        # Pre-epoch host snapshot for the replay capsule: the epoch
+        # program donates its input buffers, so the "state before the
+        # bad epoch" must be banked BEFORE dispatch — and before the
+        # timer, so the copy never pollutes step_s or the perf
+        # sentinel's step-time distribution. No-op when capsules are
+        # off, and skipped on the python path (no index matrix there,
+        # so no replayable capsule to bank state for).
+        snap = self.health.snapshot_state(self.state) if fast else None
         t_epoch = time.monotonic()
         # Chaos site INSIDE the timed region (unlike collective.step
         # above): an injected delay here inflates the measured epoch
@@ -546,9 +586,10 @@ class TrainLoop:
         from rafiki_tpu import chaos as _chaos
 
         _chaos.hook("train.epoch", key=str(self._perf_key))
-        if on_metrics is None and self._fits_device_fast_path(dataset):
+        n_steps = dataset.size // batch_size
+        poison = self._chaos_poison(n_steps)
+        if fast:
             X, Y = get_device_dataset(dataset)
-            n_steps = dataset.size // batch_size
             perm = np.random.default_rng(epoch_seed).permutation(dataset.size)
             idx = perm[: n_steps * batch_size].reshape(
                 n_steps, batch_size).astype(np.int32)
@@ -557,14 +598,17 @@ class TrainLoop:
 
                 _profiler.capture_cost(self._perf_key,
                                        self.program.train_epoch,
-                                       self.state, X, Y, idx)
-            self.state, metrics = self.program.train_epoch(self.state, X, Y, idx)
+                                       self.state, X, Y, idx, poison)
+            self.state, metrics = self.program.train_epoch(
+                self.state, X, Y, idx, poison)
             out = {k: float(v) for k, v in metrics.items()}
             self._record_epoch(t_epoch, feed_s=0.0)
+            self._health_check(out, t_epoch, epoch_seed, idx, poison, snap)
             return out
         count = 0
         metrics = None
         feed_s = 0.0
+        health_steps = []
         # One-slot prefetch (double buffering): batch i+1's host→device
         # put is issued right after step i is DISPATCHED — jit dispatch
         # is async, so the transfer overlaps the device step instead of
@@ -592,15 +636,66 @@ class TrainLoop:
             _profiler.capture_cost(self._perf_key, self._train_step,
                                    self.state, dev_batch)
         while dev_batch is not None:
+            if poison is not None and count < n_steps:
+                dev_batch = dict(dev_batch,
+                                 _health_poison=jnp.float32(poison[count]))
             self.state, metrics = self._train_step(self.state, dev_batch)
+            # Device scalars appended as-is: the per-step health series
+            # syncs to the host ONCE, at the epoch-boundary reduction.
+            health_steps.append({k: v for k, v in metrics.items()
+                                 if k.startswith(_sentinel.PREFIX)})
             dev_batch = put_next()  # overlaps the in-flight step
             if on_metrics is not None and (count % 50 == 0):
-                on_metrics(count, {k: float(v) for k, v in metrics.items()})
+                on_metrics(count, {k: float(v) for k, v in metrics.items()
+                                   if not k.startswith(_sentinel.PREFIX)})
             count += 1
         # Final-step metrics are the epoch result (one host sync per epoch).
-        out = {k: float(v) for k, v in metrics.items()} if count else {}
+        out = {k: float(v) for k, v in metrics.items()
+               if not k.startswith(_sentinel.PREFIX)} if count else {}
         self._record_epoch(t_epoch, feed_s)
+        if count:
+            series = {k: jnp.stack([h[k] for h in health_steps])
+                      for k in health_steps[0]}
+            out.update({k: float(v) for k, v
+                        in _sentinel.reduce_epoch(series).items()})
+            # No index matrix on this path -> detection and containment
+            # only; the monitor skips the replay capsule.
+            self._health_check(out, t_epoch, epoch_seed, None, poison, None)
         return out
+
+    def _chaos_poison(self, n_steps: int) -> np.ndarray:
+        """Chaos site ``train.nan``: when an active plane arms it for
+        this loop's key, corrupt ONE step's gradients (step
+        ``n_steps // 2``) via a per-step poison multiplier column
+        (docs/chaos.md). The column is ALWAYS present (all-ones when
+        quiet): multiplying grads by a runtime operand changes XLA's
+        fusion of the surrounding reductions, so a poison-free trace
+        would NOT be bit-identical to the 1.0-multiplier trace. One
+        uniform trace keeps clean epochs, faulted-run survivors, and
+        capsule replays all in the same program — the bit-parity the
+        health plane's replay verification depends on."""
+        from rafiki_tpu import chaos as _chaos
+
+        poison = np.ones(n_steps, np.float32)
+        if (_chaos.active() is not None
+                and _chaos.hook("train.nan",
+                                key=str(self._perf_key)) is not None):
+            poison[n_steps // 2] = np.nan
+        return poison
+
+    def _health_check(self, out: Dict[str, float], t0: float,
+                      epoch_seed: int, idx, poison, snapshot) -> None:
+        """Epoch-boundary health gate: strip the sentinel keys from the
+        caller-visible metric dict (the JaxModel/logger contract
+        predates the health plane) and fail the trial fast on a
+        divergence verdict."""
+        health = {k: out.pop(k) for k in list(out)
+                  if k.startswith(_sentinel.PREFIX)}
+        verdict = self.health.observe(health, t0=t0, epoch_seed=epoch_seed,
+                                      idx=idx, poison=poison,
+                                      snapshot=snapshot)
+        if verdict is not None:
+            raise DivergenceError(verdict)
 
     def _record_epoch(self, t0: float, feed_s: float) -> None:
         """Compile-vs-step-vs-feed attribution at epoch granularity: the
@@ -722,16 +817,26 @@ class PackedProgram:
         v_predict = jax.vmap(predict, in_axes=(0, None))
         v_init = jax.vmap(init_all)
 
-        def packed_train_epoch(state, X, Y, idx):
+        def packed_train_epoch(state, X, Y, idx, poison=None):
             # idx: (n_steps, k, batch) int32 — per-trial permutations.
-            def body(st, ib):
+            # poison: optional (n_steps, k) chaos train.nan multipliers;
+            # vmap hands each member its own column, so one sick member
+            # cannot perturb its pack-mates (ones-column = bit-exact).
+            def body(st, xs):
+                ib, pz = xs
                 batch = {"x": jnp.take(X, ib, axis=0),
                          "y": jnp.take(Y, ib, axis=0)}
+                if pz is not None:
+                    batch["_health_poison"] = pz
                 return v_train(st, batch)
 
-            state, ms = jax.lax.scan(body, state, idx)
-            # Final-step metrics per trial: each value is (k,).
-            return state, {key: v[-1] for key, v in ms.items()}
+            state, ms = jax.lax.scan(body, state, (idx, poison))
+            # Final-step metrics per trial: each value is (k,); the
+            # health series reduces per member on-device.
+            rest, health = _sentinel.split(ms)
+            out = {key: v[-1] for key, v in rest.items()}
+            out.update(_sentinel.reduce_epoch(health))
+            return state, out
 
         def packed_eval_epoch(params, X, Y, idx):
             # idx: (n_steps, batch) — eval order is shared (no shuffle).
@@ -797,6 +902,12 @@ class PackedTrainLoop:
         self._program_key = program_key
         self._dynamic_lr = dynamic_lr
         self._set_program()
+        # Per-member numerics health (docs/health.md): a pack never
+        # raises on divergence — run_epoch stashes per-member verdicts
+        # on ``last_verdicts`` and the pack driver (train_packed)
+        # evicts only the sick member.
+        self.health = HealthMonitor(str(self._perf_key), k=self.k)
+        self.last_verdicts: list = [None] * self.k
 
         # Per-trial rng derivation matches TrainLoop exactly: key(seed)
         # split once; row 0 carries on as the step rng, row 1 seeds init.
@@ -853,6 +964,9 @@ class PackedTrainLoop:
             lambda a: jnp.concatenate([a[:i], a[i + 1:]], axis=0), self.state)
         self.k -= 1
         self._set_program()
+        self.health.evict_member(i)
+        if i < len(self.last_verdicts):
+            self.last_verdicts.pop(i)
         telemetry.inc("trial_pack.evictions")
         return evicted
 
@@ -880,6 +994,8 @@ class PackedTrainLoop:
             lambda a, b: jnp.concatenate([a, b], axis=0), self.state, member)
         self.k += 1
         self._set_program()
+        self.health.admit_member()
+        self.last_verdicts.append(None)
         telemetry.inc("trial_pack.backfills")
         return self.k - 1
 
@@ -913,6 +1029,10 @@ class PackedTrainLoop:
             raise ValueError(
                 f"Dataset has {dataset.size} examples < batch_size={batch_size}; "
                 f"the epoch would run zero steps")
+        # Pre-epoch stacked-state snapshot for replay capsules (sliced
+        # per sick member only on trip); banked before the timer so the
+        # copy never pollutes step_s. See TrainLoop.run_epoch.
+        snap = self.health.snapshot_state(self.state)
         t_epoch = time.monotonic()
         # Same in-timed-region chaos site as the serial loop: injected
         # delays here are visible to the anomaly detector.
@@ -926,6 +1046,7 @@ class PackedTrainLoop:
             np.random.default_rng(int(s)).permutation(dataset.size)
             [: n_steps * batch_size].reshape(n_steps, batch_size)
             for s in epoch_seeds], axis=1).astype(np.int32)
+        poison = self._chaos_poison(n_steps)
         if self._fits_device_fast_path(dataset):
             X, Y = get_device_dataset(dataset)
             if not getattr(self, "_warm", False):
@@ -933,23 +1054,78 @@ class PackedTrainLoop:
 
                 _profiler.capture_cost(self._perf_key,
                                        self.program.train_epoch,
-                                       self.state, X, Y, idx,
+                                       self.state, X, Y, idx, poison,
                                        kind="packed", k=self.k)
-            self.state, metrics = self.program.train_epoch(self.state, X, Y, idx)
+            self.state, metrics = self.program.train_epoch(
+                self.state, X, Y, idx, poison)
             self._record_epoch(t_epoch)
             host = {key: np.asarray(jax.device_get(v)) for key, v in metrics.items()}
-            return [{key: float(v[i]) for key, v in host.items()}
+            rows = [{key: float(v[i]) for key, v in host.items()}
                     for i in range(self.k)]
+            return self._health_check(rows, t_epoch, epoch_seeds, idx,
+                                      poison, snap)
         metrics = None
+        health_steps = []
         for t in range(n_steps):
             ib = idx[t]  # (k, batch)
             batch = {"x": jnp.asarray(dataset.x[ib]),
                      "y": jnp.asarray(dataset.y[ib])}
+            if poison is not None:
+                batch["_health_poison"] = jnp.asarray(poison[t])
             self.state, metrics = self.program.train_step(self.state, batch)
+            # (k,) device vectors appended as-is — the health series
+            # syncs once, at the epoch-boundary reduction below.
+            health_steps.append({k: v for k, v in metrics.items()
+                                 if k.startswith(_sentinel.PREFIX)})
         self._record_epoch(t_epoch)
-        host = {key: np.asarray(jax.device_get(v)) for key, v in metrics.items()}
-        return [{key: float(v[i]) for key, v in host.items()}
+        reduced = _sentinel.reduce_epoch(
+            {k: jnp.stack([h[k] for h in health_steps])
+             for k in health_steps[0]})
+        host = {key: np.asarray(jax.device_get(v))
+                for key, v in metrics.items()
+                if not key.startswith(_sentinel.PREFIX)}
+        host.update({key: np.asarray(jax.device_get(v))
+                     for key, v in reduced.items()})
+        rows = [{key: float(v[i]) for key, v in host.items()}
                 for i in range(self.k)]
+        return self._health_check(rows, t_epoch, epoch_seeds, idx,
+                                  poison, snap)
+
+    def _chaos_poison(self, n_steps: int) -> np.ndarray:
+        """Per-member ``train.nan`` poison plane: each live member is a
+        distinct hook key (``<perf_key>@m<i>`` — ``@`` because the spec
+        grammar reserves ``:``), so a chaos spec's ``match=@m2`` selects
+        WHICH pack member diverges. The matrix is ALWAYS present
+        (all-ones when quiet) for the same single-trace reason as the
+        serial column — see :meth:`TrainLoop._chaos_poison`. Members
+        whose column stays all-ones are bit-unaffected (the multiply is
+        exact and the trace is uniform) — the isolation the
+        nan-trial-contained scenario pins."""
+        from rafiki_tpu import chaos as _chaos
+
+        poison = np.ones((n_steps, self.k), np.float32)
+        if _chaos.active() is not None:
+            hit = [i for i in range(self.k)
+                   if _chaos.hook("train.nan",
+                                  key=f"{self._perf_key}@m{i}") is not None]
+            poison[n_steps // 2, hit] = np.nan
+        return poison
+
+    def _health_check(self, rows: list, t0: float, epoch_seeds, idx,
+                      poison, snapshot) -> list:
+        """Epoch-boundary health gate, pack flavor: strip the sentinel
+        keys from the per-member metric rows and stash one
+        Optional[verdict] per live slot on ``last_verdicts``. A pack
+        never raises — survivors must keep training; the pack driver
+        evicts sick members (docs/health.md)."""
+        health_rows = [{k: v for k, v in r.items()
+                        if k.startswith(_sentinel.PREFIX)} for r in rows]
+        clean = [{k: v for k, v in r.items()
+                  if not k.startswith(_sentinel.PREFIX)} for r in rows]
+        self.last_verdicts = self.health.observe_pack(
+            health_rows, t0=t0, epoch_seeds=epoch_seeds, idx=idx,
+            poison=poison, snapshot=snapshot)
+        return clean
 
     def _record_epoch(self, t0: float) -> None:
         from rafiki_tpu.obs.ledger import ledger

@@ -31,6 +31,7 @@ from rafiki_tpu.model.base import BaseModel, load_model_class
 from rafiki_tpu.model.knobs import Knobs, knob_config_signature
 from rafiki_tpu.model.log import logger
 from rafiki_tpu.obs import context as trace_context
+from rafiki_tpu.obs import health as _health
 from rafiki_tpu.obs.journal import journal
 from rafiki_tpu.obs.ledger import ledger
 from rafiki_tpu.store import MetaStore, ParamsStore
@@ -251,6 +252,28 @@ class TrainWorker:
             else:
                 with logger.capture(sink):
                     self._persist(tid, model, score)
+            return self.store.get_trial(tid)
+        except _health.DivergenceError as e:
+            # Numerics containment (docs/health.md): the train loop
+            # already journaled the divergence, banked the replay
+            # capsule and charged the wasted wall to badput. The
+            # worker's half of the contract is to fail the trial FAST
+            # with the diagnosis (not a stack trace), steer the advisor
+            # away from the region, and keep the worker loop alive.
+            v = e.verdict
+            telemetry.inc("worker.trials_errored")
+            self.store.mark_trial_as_errored(tid, f"diverged: {e}")
+            events.emit("trial_diverged", trial_id=tid,
+                        worker_id=self.worker_id,
+                        divergence=v.get("divergence"),
+                        bad_step=v.get("bad_step"),
+                        capsule=v.get("capsule"),
+                        diagnosis=v.get("diagnosis"))
+            _health.note_contained()
+            try:
+                self.advisor.feedback(0.0, knobs)
+            except Exception:
+                pass
             return self.store.get_trial(tid)
         except Exception:
             err = traceback.format_exc()
@@ -702,8 +725,22 @@ class PackedTrialRunner:
                         models, w.train_uri, on_epoch=heartbeat,
                         checkpoint_sink=ckpt_sink,
                         backfill=backfill, on_evict=on_evict)
+                # Numerics containment (docs/health.md): members the
+                # pack evicted for divergence carry a verdict and hold
+                # their params as-of the bad epoch — they must not
+                # reach evaluation (a NaN score row would poison the
+                # advisor's scale). Survivors evaluate as usual.
+                verdicts = [getattr(m, "_health_verdict", None)
+                            for m in models]
+                healthy_idx = [i for i, v in enumerate(verdicts)
+                               if v is None]
                 with telemetry.span("trial_pack.evaluate"):
-                    scores = w.model_class.evaluate_packed(models, w.val_uri)
+                    healthy_scores = (w.model_class.evaluate_packed(
+                        [models[i] for i in healthy_idx], w.val_uri)
+                        if healthy_idx else [])
+                scores: List[Optional[float]] = [None] * len(models)
+                for j, i in enumerate(healthy_idx):
+                    scores[i] = healthy_scores[j]
         except PackAborted:
             # Supervisor-driven teardown: rows STAY RUNNING (the mesh
             # re-packs them onto surviving chips), device state is
@@ -756,6 +793,32 @@ class PackedTrialRunner:
                         wall_s=(round_walls[pos]
                                 if pos < len(round_walls) else None),
                         packed=True)
+            if verdicts[i] is not None:
+                # Same contract as the serial DivergenceError arm:
+                # ERRORED with the diagnosis, floor score to the
+                # advisor, containment counted — and no persistence
+                # (the params ARE the divergent state; the capsule is
+                # the forensic artifact, not the params store).
+                v = verdicts[i]
+                telemetry.inc("worker.trials_errored")
+                w.store.mark_trial_as_errored(
+                    tid, f"diverged: {v.get('diagnosis')}")
+                events.emit("trial_diverged", trial_id=tid,
+                            worker_id=w.worker_id,
+                            divergence=v.get("divergence"),
+                            bad_step=v.get("bad_step"),
+                            capsule=v.get("capsule"),
+                            diagnosis=v.get("diagnosis"))
+                _health.note_contained()
+                try:
+                    w.advisor.feedback(0.0, kn)
+                except Exception:
+                    pass
+                try:
+                    models[i].destroy()
+                except Exception:
+                    pass
+                continue
             score = float(scores[i])
             w.advisor.feedback(score, kn)
             telemetry.inc("worker.trials_succeeded")

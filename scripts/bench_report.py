@@ -117,6 +117,17 @@ def headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+def health_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """The ``detail.health`` numerics block (docs/health.md), when the
+    artifact carries one. Trended as ADVISORY context — a round with
+    divergences explains a throughput dip, it is not itself a
+    regression verdict (the badput is already in the goodput split)."""
+    if not isinstance(payload, dict) or payload.get("error"):
+        return {}
+    h = (payload.get("detail") or {}).get("health")
+    return h if isinstance(h, dict) else {}
+
+
 def _measurable(v: Any) -> bool:
     return isinstance(v, (int, float)) and v > 0
 
@@ -184,6 +195,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     metrics = trend(rounds, args.tolerance)
     regressed = sorted(m for m, e in metrics.items()
                        if e["verdict"] == "regressed")
+    health_points = [dict(round=r["round"], **health_of(r["payload"]))
+                     for r in rounds if health_of(r["payload"])]
     report = {
         "schema_version": REPORT_SCHEMA_VERSION,
         "tolerance": args.tolerance,
@@ -193,6 +206,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "has_data": bool(headline_of(r["payload"]))}
                    for r in rounds],
         "metrics": metrics,
+        "health": {
+            "trajectory": health_points,
+            "latest_divergences": (health_points[-1].get("divergences")
+                                   if health_points else None),
+        },
         "regressed": regressed,
         "verdict": "regressed" if regressed else "ok",
     }

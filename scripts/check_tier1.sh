@@ -45,6 +45,13 @@ env JAX_PLATFORMS=cpu python scripts/perf_smoke.py > /tmp/_perf_smoke.json \
 # (no fault fired) also fails the gate.
 env JAX_PLATFORMS=cpu python scripts/mesh_smoke.py > /tmp/_mesh_smoke.json \
   || { echo "TIER1 MESH SMOKE FAILED (see /tmp/_mesh_smoke.json)"; exit 1; }
+# Numerics-health smoke: a quiet 2-trial round must trip nothing,
+# then an injected train.nan must land a contained ERRORED trial, a
+# health/divergence verdict, a replay capsule — and the real
+# `obs replay` CLI must reproduce the divergent step bit-exactly in a
+# fresh process (docs/health.md). ~13s.
+env JAX_PLATFORMS=cpu python scripts/health_smoke.py > /tmp/_health_smoke.json \
+  || { echo "TIER1 HEALTH SMOKE FAILED (see /tmp/_health_smoke.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""Numerics-health CI smoke: the whole containment chain, both polarities.
+
+Two phases in one process (docs/health.md):
+
+  1. **Quiet run (no injection)** — a 2-trial serial TrainWorker round
+     under a fresh journal dir. The sentinels are ON (they always are)
+     but must stay silent: ZERO ``health/divergence`` records, ZERO
+     ``capsule-*.rcap`` files, zero divergences in ``health.stats()``,
+     and the real ``obs health`` CLI must render a clean bill (exit 0).
+     The same journals must also surface both trials' learning curves
+     through ``obs curves --json`` — the quiet half of the plane.
+
+  2. **Injected run** — same process, reset stores, chaos plane now
+     corrupting one mid-epoch step's gradients to NaN in the first
+     trial (``train.nan``, ``times=1``): that trial must land ERRORED with a
+     ``diverged:`` diagnosis while the second trial completes and
+     scores (containment); the journal must carry the
+     ``health/divergence`` verdict AND its ``health/capsule`` pointer;
+     and the capsule must re-execute **bit-exactly** through the real
+     ``python -m rafiki_tpu.obs replay`` CLI in a fresh process — the
+     deterministic-replay contract, enforced end to end.
+
+Output: one JSON object on stdout. Exit code: 0 when every assertion
+holds; 1 otherwise — this is a CI gate (scripts/check_tier1.sh).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TRAIN = "synthetic://images?classes=4&n=128&w=8&h=8&c=1&seed=0"
+VAL = "synthetic://images?classes=4&n=64&w=8&h=8&c=1&seed=1"
+NAN_SPEC = "seed=3;train.nan:nan:times=1"
+
+
+def _run(cmd, timeout=300):
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+class _ScriptedAdvisor:
+    """Fixed knobs: both phases train the identical program, so the
+    quiet phase doubles as the no-false-positive control for the
+    injected phase's detection."""
+
+    def __init__(self):
+        self.fed = []
+
+    def propose(self):
+        return dict(hidden_layers=1, hidden_units=32, learning_rate=1e-3,
+                    batch_size=32, epochs=2, seed=0)
+
+    def propose_batch(self, n):
+        return [self.propose() for _ in range(n)]
+
+    def feedback(self, score, knobs):
+        self.fed.append(round(float(score), 6))
+
+
+def _fresh_stores(log_dir):
+    """Point the journal at a fresh dir and zero every in-process
+    accumulator the two phases must not share."""
+    from rafiki_tpu import telemetry
+    from rafiki_tpu.obs import health
+    from rafiki_tpu.obs.journal import journal
+    from rafiki_tpu.obs.ledger import ledger
+
+    os.environ["RAFIKI_LOG_DIR"] = log_dir
+    journal.configure(log_dir, role="healthsmoke")
+    telemetry.reset()
+    ledger.reset()
+    health.reset_stats()
+
+
+def run_serial_round(n_trials):
+    """One serial TrainWorker round; returns the final trial rows and
+    the advisor's feedback log."""
+    from rafiki_tpu.models.ff import FeedForward
+    from rafiki_tpu.store import MetaStore, ParamsStore
+    from rafiki_tpu.worker.train import TrainWorker
+
+    with tempfile.TemporaryDirectory(prefix="rafiki-healthsmoke-db-") as tmp:
+        store = MetaStore(os.path.join(tmp, "meta.sqlite3"))
+        params = ParamsStore(os.path.join(tmp, "params"))
+        model = store.create_model("healthff", "IMAGE_CLASSIFICATION", None,
+                                   b"", "FeedForward")
+        job = store.create_train_job("healthsmoke", "IMAGE_CLASSIFICATION",
+                                     None, TRAIN, VAL,
+                                     {"MODEL_TRIAL_COUNT": n_trials})
+        sub = store.create_sub_train_job(job["id"], model["id"])
+        adv = _ScriptedAdvisor()
+        worker = TrainWorker(store, params, sub["id"], FeedForward, adv,
+                             TRAIN, VAL, {"MODEL_TRIAL_COUNT": n_trials},
+                             async_persist=False)
+        n = worker.run()
+        return n, store.get_trials_of_sub_train_job(sub["id"]), adv.fed
+
+
+def _health_cli(log_dir):
+    proc = _run([sys.executable, "-m", "rafiki_tpu.obs", "--dir", log_dir,
+                 "--json", "health"])
+    if proc.returncode != 0:
+        raise RuntimeError(f"obs health exited {proc.returncode}: "
+                           f"{proc.stderr.strip()[:200]}")
+    return json.loads(proc.stdout)
+
+
+def check_quiet(problems, quiet_dir):
+    """Phase 1: the sentinel must not cry wolf on a clean run — and the
+    journals it leaves must still surface the learning curves."""
+    from rafiki_tpu.obs import health
+    from rafiki_tpu.obs.journal import journal
+
+    n, trials, _fed = run_serial_round(2)
+    if n != 2:
+        problems.append(f"quiet round ran {n}/2 trials")
+    bad = [t for t in trials if t["status"] != "COMPLETED"]
+    if bad:
+        problems.append(f"quiet run left non-COMPLETED trials: "
+                        f"{[(t['status'], t['error']) for t in bad][:2]}")
+    stats = health.stats()
+    if stats["divergences"] or stats["capsules"]:
+        problems.append(f"uninjected run tripped the detector: {stats}")
+    caps = glob.glob(os.path.join(quiet_dir, "capsule-*.rcap"))
+    if caps:
+        problems.append(f"uninjected run dumped {len(caps)} capsules")
+    journal.close()  # flush before subprocess readers
+    try:
+        report = _health_cli(quiet_dir)
+        if report["divergences"] or report["capsule_errors"]:
+            problems.append(f"obs health on quiet dir not clean: "
+                            f"{str(report)[:200]}")
+    except (RuntimeError, ValueError) as e:
+        problems.append(f"obs health failed on quiet dir: {e}")
+    curves = {}
+    proc = _run([sys.executable, "-m", "rafiki_tpu.obs", "--dir", quiet_dir,
+                 "--json", "curves"])
+    if proc.returncode != 0:
+        problems.append(f"obs curves exited {proc.returncode} on quiet dir")
+    else:
+        curves = json.loads(proc.stdout)["trials"]
+        if len(curves) != 2 or any(len(v) < 2 for v in curves.values()):
+            problems.append(f"obs curves surfaced "
+                            f"{ {k: len(v) for k, v in curves.items()} }, "
+                            "expected 2 trials x >=2 epochs")
+    return {"trials": n, "stats": stats, "curve_trials": len(curves)}
+
+
+def check_injected(problems, injected_dir):
+    """Phase 2: injected NaN -> contained trial -> capsule -> the real
+    replay CLI reproduces the divergent step bit-exactly."""
+    from rafiki_tpu import chaos
+    from rafiki_tpu.obs import health
+    from rafiki_tpu.obs.journal import journal, read_dir
+
+    os.environ["RAFIKI_CHAOS"] = NAN_SPEC
+    try:
+        chaos.reset_from_env()
+        n, trials, fed = run_serial_round(2)
+    finally:
+        os.environ.pop("RAFIKI_CHAOS", None)
+        chaos.reset_from_env()
+    if n != 2:
+        problems.append(f"injected round ran {n}/2 trials")
+    statuses = sorted(t["status"] for t in trials)
+    if statuses != ["COMPLETED", "ERRORED"]:
+        problems.append(f"injected run statuses {statuses}, expected "
+                        "one contained ERRORED + one COMPLETED survivor")
+    else:
+        sick = next(t for t in trials if t["status"] == "ERRORED")
+        if "diverged" not in (sick["error"] or ""):
+            problems.append(f"errored trial lacks diverged diagnosis: "
+                            f"{sick['error']!r}")
+        good = next(t for t in trials if t["status"] == "COMPLETED")
+        if good["score"] is None:
+            problems.append("surviving trial completed without a score")
+        if 0.0 not in fed:
+            problems.append("diverged trial never fed the floor score "
+                            "back to the advisor")
+    stats = health.stats()
+    if stats["divergences"] != 1 or stats["contained"] != 1:
+        problems.append(f"injected stats off: {stats}")
+    recs = [r for r in read_dir(injected_dir) if r.get("kind") == "health"]
+    names = {r.get("name") for r in recs}
+    if "divergence" not in names or "capsule" not in names:
+        problems.append(f"journal missing health records, saw {sorted(names)}")
+    caps = sorted(glob.glob(os.path.join(injected_dir, "capsule-*.rcap")))
+    journal.close()
+    replay = {}
+    if not caps:
+        problems.append("injected divergence dumped no capsule")
+    else:
+        # The contract, end to end: a FRESH process re-executes the
+        # capsule through the operator CLI and bit-verifies it.
+        proc = _run([sys.executable, "-m", "rafiki_tpu.obs", "--json",
+                     "replay", caps[-1]])
+        try:
+            replay = json.loads(proc.stdout or "{}")
+        except ValueError:
+            replay = {}
+        if proc.returncode != 0:
+            problems.append(f"obs replay exited {proc.returncode}: "
+                            f"{(replay.get('mismatches') or proc.stderr.strip())!s:.200}")
+        elif not replay.get("reproduced") or not replay.get("poisoned"):
+            problems.append(f"replay did not reproduce the poisoned step: "
+                            f"{str(replay)[:200]}")
+    return {"trials": n, "stats": stats, "capsules": len(caps),
+            "replay_reproduced": bool(replay.get("reproduced"))}
+
+
+def main() -> int:
+    os.environ.pop("RAFIKI_CHAOS", None)  # phase 1 must be uninjected
+
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()
+
+    from rafiki_tpu import chaos
+
+    chaos.reset_from_env()
+    t0 = time.monotonic()
+    problems = []
+    with tempfile.TemporaryDirectory(prefix="rafiki-healthsmoke-") as tmp:
+        quiet_dir = os.path.join(tmp, "quiet")
+        _fresh_stores(quiet_dir)
+        quiet = check_quiet(problems, quiet_dir)
+
+        injected_dir = os.path.join(tmp, "injected")
+        _fresh_stores(injected_dir)
+        injected = check_injected(problems, injected_dir)
+
+        os.environ.pop("RAFIKI_LOG_DIR", None)
+        out = {
+            "quiet": quiet,
+            "injected": injected,
+            # lint: disable=RF007 — smoke artifact wall-clock
+            "wall_s": round(time.monotonic() - t0, 3),
+        }
+        if problems:
+            out["problems"] = problems
+        print(json.dumps(out))
+        return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

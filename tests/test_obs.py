@@ -270,6 +270,13 @@ _SNAPSHOT = {
         "state": {"step_anomaly_rate": {"breaching": 1, "threshold": 0.05,
                                         "value": 0.2, "burn": 4.0}},
     },
+    "health": {
+        "divergences": 1,
+        "capsules": 1,
+        "evictions": 0,
+        "contained": 1,
+        "badput_charged_s": 2.25,
+    },
 }
 
 
