@@ -1,0 +1,61 @@
+"""RF009 wall-clock-duration.
+
+Request-anatomy finding (PR 10, docs/serving_anatomy.md): latency and
+duration math must run on the monotonic clock. ``time.time()`` is the
+WALL clock — NTP slews it continuously and steps it discontinuously,
+so a ``time.time() - start`` delta can be wrong by the full step (and
+even negative), silently corrupting latencies, lease math, SLO inputs
+and the hop marks the serving waterfall subtracts across processes.
+``time.monotonic()`` exists for exactly this subtraction — and on
+Linux ``CLOCK_MONOTONIC`` is system-wide, so it also covers the
+cross-process hop-mark case.
+
+The flagged shape is ``time.time() - <anything>``: a call on the LEFT
+of a subtraction reads as "now minus an earlier instant", i.e. an
+elapsed duration. The converse shapes stay legal:
+
+* ``deadline - time.time()`` — a remaining-budget read against a
+  wall-clock deadline (mirrors RF007's documented exception);
+* ``t0 = time.time()`` alone — a timestamp, not a delta; journals and
+  artifacts legitimately carry wall timestamps.
+
+Legitimate wall-clock deltas exist — epoch cutoffs compared against
+timestamps persisted across restarts, or beats shared between
+processes on a wall basis — and those justify-suppress, stating WHY
+the wall clock is the shared clock there.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+from rafiki_tpu.analysis.checkers._ast_util import dotted_name
+
+
+@register
+class WallClockDuration(Checker):
+    id = "RF009"
+    name = "wall-clock-duration"
+    severity = "error"
+    rationale = ("`time.time() - x` measures a duration on the wall "
+                 "clock: NTP slew/steps corrupt latencies, lease math "
+                 "and SLO inputs — subtract time.monotonic() instead, "
+                 "or justify-suppress a genuine cross-process epoch "
+                 "comparison")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)
+                    and isinstance(node.left, ast.Call)
+                    and dotted_name(node.left.func) == "time.time"):
+                findings.append(self.finding(
+                    ctx, node,
+                    "`time.time() - ...` is a wall-clock duration: NTP "
+                    "slew/steps make it wrong (even negative) — use "
+                    "time.monotonic() for elapsed time, or "
+                    "justify-suppress a cross-process epoch cutoff"))
+        return findings

@@ -50,6 +50,7 @@ from collections import deque
 from rafiki_tpu import telemetry
 from rafiki_tpu.chaos import hook as _chaos
 from rafiki_tpu.obs import context as _trace_context
+from rafiki_tpu.obs.anatomy import hops as _hops
 from rafiki_tpu.obs.journal import journal as _journal
 
 
@@ -71,6 +72,13 @@ def _envelope(query_id: str, query: Any,
     trace = trace or _current_trace()
     if trace is None:
         return (query_id, query)
+    if "hops" not in trace:
+        # Hop marks ride the envelope (docs/serving_anatomy.md): the
+        # gateway's thread-local prefix (admit/queue), then the enqueue
+        # mark stamped here. Copy before annotating — an explicit trace
+        # arg may be a caller-owned dict shared across queries.
+        trace = dict(trace)
+        trace["hops"] = _hops.prefix_marks() + [_hops.mark("enq")]
     # Journal the fan-out hop so the bus appears in the stitched trace.
     _journal.record("bus", "add_query", query_id=query_id,
                     trace_id=trace.get("trace_id"),
@@ -254,13 +262,19 @@ class InProcBus:
 
     # -- predictions ---------------------------------------------------------
 
-    def put_prediction(self, query_id: str, worker_id: str, prediction: Any) -> None:
+    def put_prediction(self, query_id: str, worker_id: str, prediction: Any,
+                       hops: Optional[list] = None) -> None:
         if _chaos("bus.put_prediction", worker_id) == "drop":
             return  # injected reply loss
+        # Reply-leg hop carriage, back-compat like the query-leg trace
+        # 3-tuple: plain replies stay (worker_id, prediction); a worker
+        # with a hop chain appends it as an optional third element.
+        item = ((worker_id, prediction) if hops is None
+                else (worker_id, prediction, hops))
         with self._pred_cv:
             if query_id in self._expired_set:
                 return  # late answer to a timed-out query: drop, don't leak
-            self._preds.setdefault(query_id, []).append((worker_id, prediction))
+            self._preds.setdefault(query_id, []).append(item)
             self._pred_cv.notify_all()
 
     def get_predictions(self, query_id: str, n: int,
@@ -390,12 +404,14 @@ class _MpBus:
         ws = self._workers.get(job_id, ())
         if max_age_s is None:
             return sorted(ws)
+        # lint: disable=RF009 — lease cutoff vs cross-process wall-clock beats, not a duration
         cutoff = time.time() - max_age_s
         ts = dict(self._worker_ts)
         # Auto-janitor (same contract as InProcBus.get_workers): the
         # stale set is computed from this read's snapshot, then reaped
         # through reap_stale — a lock-free read here, so no deadlock.
         reap_age = max_age_s * self._reap_factor
+        # lint: disable=RF009 — reap cutoff vs cross-process wall-clock beats, not a duration
         if any(ts.get(f"{job_id}|{w}", 0.0) < time.time() - reap_age
                for w in ws):
             self.reap_stale(reap_age, job_id)
@@ -407,6 +423,7 @@ class _MpBus:
         manager proxies (copy-on-write tuple rebuild under the lock).
         The reap counter is per-process — whichever process runs the
         janitor (normally the predictor's) observes the reaps."""
+        # lint: disable=RF009 — lease cutoff vs cross-process wall-clock beats, not a duration
         cutoff = time.time() - max_age_s
         reaped = []
         with self._lock:
@@ -460,15 +477,18 @@ class _MpBus:
                 return []
             time.sleep(0.005)
 
-    def put_prediction(self, query_id, worker_id, prediction):
+    def put_prediction(self, query_id, worker_id, prediction, hops=None):
         if _chaos("bus.put_prediction", worker_id) == "drop":
             return
         self._proxy("put_prediction")
+        # Same optional-3rd-element reply shape as InProcBus.
+        item = ((worker_id, prediction) if hops is None
+                else (worker_id, prediction, hops))
         with self._lock:
             if query_id in self._expired:
                 return  # late answer to a timed-out query: drop, don't leak
             self._preds[query_id] = (self._preds.get(query_id, ())
-                                     + ((worker_id, prediction),))
+                                     + (item,))
 
     def get_predictions(self, query_id, n, timeout=10.0, min_n=None,
                         grace_s=None):

@@ -40,6 +40,8 @@ from rafiki_tpu import chaos, telemetry
 from rafiki_tpu.gateway.admission import AdmissionController, ShedError
 from rafiki_tpu.gateway.breaker import CircuitBreaker
 from rafiki_tpu.obs import context as trace_context
+from rafiki_tpu.obs.anatomy import hops as _hops
+from rafiki_tpu.obs.anatomy.timeseries import ServingRollup
 from rafiki_tpu.obs.journal import journal as _journal
 
 POLICIES = ("replicate-all", "least-loaded")
@@ -103,10 +105,15 @@ class Gateway:
         self._hedged = 0
         self._timeouts = 0
         self._latency_ewma_s: Optional[float] = None
+        # Continuous serving time-series (docs/serving_anatomy.md):
+        # every outcome lands in a per-second rollup journaled as
+        # serving/ts, with admission/breaker context merged per row.
+        self.rollup = ServingRollup(context_fn=self._rollup_context)
         # Latest gateway wins the collector slot: one predictor process
         # serves one job, and tests that build several gateways only
         # ever assert on the live one.
         telemetry.register_collector("gateway", self.stats)
+        telemetry.register_collector("serving", self.rollup.collector)
 
     # -- the predict path ----------------------------------------------------
 
@@ -126,6 +133,19 @@ class Gateway:
 
     def _predict(self, queries: List[Any],
                  deadline_s: Optional[float]) -> List[Any]:
+        # Open this request's hop-mark prefix (docs/serving_anatomy.md):
+        # admit/queue marks stamped here ride into every bus envelope
+        # the fan-out produces. Cleared in the finally — a stale prefix
+        # would leak this request's marks into the thread's next chain.
+        _hops.begin()
+        _hops.add("admit")
+        try:
+            return self._predict_admitted(queries, deadline_s)
+        finally:
+            _hops.clear()
+
+    def _predict_admitted(self, queries: List[Any],
+                          deadline_s: Optional[float]) -> List[Any]:
         deadline_s = (deadline_s or self.cfg.default_deadline_s
                       or self.predictor.timeout_s)
         deadline = time.monotonic() + deadline_s
@@ -144,6 +164,7 @@ class Gateway:
         except ShedError as e:
             self._count_shed(e.reason)
             raise
+        _hops.add("queue")  # admission granted: the queue wait is over
         with self._lock:
             self._admitted += 1
         telemetry.inc("gateway.admitted")
@@ -178,6 +199,15 @@ class Gateway:
         # evaluates (docs/perf.md). The gather span measures the same
         # region but span summaries don't feed SLO sources directly.
         telemetry.observe("gateway.predict_s", elapsed)
+        ok = report.timeouts == 0
+        self.rollup.observe(latency_s=elapsed,
+                            outcome="ok" if ok else "error")
+        # Independent end-to-end record for hop-sum reconciliation:
+        # obs waterfall / obs tails cross-check the stitched chain's
+        # total against this gateway-measured elapsed for the trace.
+        _journal.record("serving", "request", queries=len(queries),
+                        e2e_s=round(elapsed, 6), ok=ok,
+                        hedged=report.hedged, timeouts=report.timeouts)
         from rafiki_tpu.obs.perf import slo as _slo
 
         _slo.maybe_tick()
@@ -254,9 +284,22 @@ class Gateway:
         backlog = self.admission.waiting + 1
         return round(max(0.1, ewma * backlog / self.cfg.max_inflight), 3)
 
+    def _rollup_context(self) -> Dict[str, Any]:
+        """Live context merged into each serving/ts row: queue depth,
+        inflight, and the per-worker breaker states."""
+        with self._lock:
+            breakers = {w: b.snapshot().get("state")
+                        for w, b in self._breakers.items()}
+        return {"queue_depth": self.admission.waiting,
+                "inflight": self.admission.inflight,
+                "breakers": breakers,
+                "breakers_open": sum(1 for s in breakers.values()
+                                     if s != "closed")}
+
     def _count_shed(self, reason: str) -> None:
         with self._lock:
             self._shed[reason] = self._shed.get(reason, 0) + 1
+        self.rollup.observe(outcome="shed")
         telemetry.inc("gateway.shed")
         # Reasons are a closed enum of admission code paths, refining
         # the stable literal gateway.shed aggregate above.

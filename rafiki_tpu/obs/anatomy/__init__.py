@@ -1,0 +1,23 @@
+"""Request anatomy plane (docs/serving_anatomy.md).
+
+Rafiki's thesis is that the *system*, not the model, dominates served
+query latency — so the serving path must be decomposable per hop or
+every perf claim about it is folklore. This package is that
+decomposition, built on the PR 6 trace/journal substrate:
+
+* :mod:`hops` — compact per-hop timestamp marks carried inside the bus
+  envelope, segment math, and the absorb step that turns a gathered
+  chain into histograms + journal records.
+* :mod:`exemplars` — a slowest-N-per-window ring retaining FULL
+  waterfalls for exactly the requests percentile summaries erase.
+* :mod:`timeseries` — the per-second serving rollup journaled as
+  ``serving/ts`` records (qps, p50/p99, shed rate, queue depth,
+  inflight, breaker state).
+
+Stitching and rendering live in the obs CLI (``obs waterfall``,
+``obs tails``, ``obs serving``).
+"""
+
+from rafiki_tpu.obs.anatomy import exemplars, hops, timeseries  # noqa: F401
+
+__all__ = ["exemplars", "hops", "timeseries"]

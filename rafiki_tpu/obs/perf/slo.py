@@ -107,6 +107,10 @@ def default_specs() -> List[SloSpec]:
         SloSpec("divergence_rate", "counter:health.divergences", 0.02,
                 description="numerics divergences under ~1/min sustained "
                             "(docs/health.md)"),
+        SloSpec("serving_forward_p99", "hist_p99:serving.hop.forward_s",
+                1.0, description="per-hop latency budget "
+                                 "(docs/serving_anatomy.md): device "
+                                 "forward p99 under 1s"),
     ]
 
 

@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional
 
 from rafiki_tpu import telemetry
 from rafiki_tpu.obs import context as trace_context
+from rafiki_tpu.obs.anatomy import hops as _hops
 from rafiki_tpu.obs.journal import journal as _journal
 from rafiki_tpu.predictor.ensemble import ensemble_predictions
 
@@ -177,15 +178,23 @@ class Predictor:
             telemetry.observe("predictor.gather_quorum_s",
                               # lint: disable=RF007 — the delta IS the observation
                               time.monotonic() - t_q)
-            for w, _ in preds:
-                replies[w] = replies.get(w, 0) + 1
+            # The quorum/hedge decision closes every hop chain: replies
+            # are (worker, pred) or (worker, pred, hops) — index, don't
+            # destructure, so plain replies keep working.
+            dec = _hops.mark("dec")
+            chains = {item[0]: list(item[2]) + [dec]
+                      for item in preds if len(item) > 2 and item[2]}
+            if chains:
+                _hops.absorb(qid, chains)
+            for item in preds:
+                replies[item[0]] = replies.get(item[0], 0) + 1
             if not preds:
                 timeouts += 1
                 out.append({"error": "prediction timeout"})
             else:
                 if len(preds) < len(workers):
                     hedged += 1
-                out.append(ensemble_predictions([p for _, p in preds]))
+                out.append(ensemble_predictions([item[1] for item in preds]))
         # lint: disable=RF007 — observed into gather_s right below
         elapsed = time.monotonic() - t_gather
         telemetry.observe("predictor.gather_s", elapsed)

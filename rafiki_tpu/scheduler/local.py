@@ -67,7 +67,7 @@ class LocalScheduler:
         that fail the packing eligibility checks (mesh, multihost,
         custom preprocess, masked dataset).
         """
-        t0 = time.time()
+        t0 = time.monotonic()
         job = self.store.get_train_job(job_id)
         if job is None:
             raise KeyError(f"No train job {job_id!r}")
@@ -152,15 +152,17 @@ class LocalScheduler:
             status = TrainJobStatus.COMPLETED.value
         self.store.update_train_job_status(job_id, status)
         telemetry.inc("scheduler.train_jobs_finished")
-        telemetry.observe("scheduler.train_job_s", time.time() - t0)
+        # lint: disable=RF007 — job duration observed into train_job_s right here
+        dur_s = time.monotonic() - t0
+        telemetry.observe("scheduler.train_job_s", dur_s)
         events.emit("train_job_finished", job_id=job_id, status=status,
-                    duration_s=round(time.time() - t0, 3))
+                    duration_s=round(dur_s, 3))
         return TrainJobResult(
             job_id=job_id,
             status=status,
             trials=self.store.get_trials_of_train_job(job_id),
             best_trials=self.store.get_best_trials_of_train_job(job_id, limit=2),
-            duration_s=time.time() - t0,
+            duration_s=dur_s,
             errors=errors,
         )
 

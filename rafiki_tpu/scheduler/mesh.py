@@ -211,7 +211,7 @@ class MeshSweepScheduler:
         stop_event: Optional[threading.Event] = None,
     ) -> TrainJobResult:
         """Run a train job as one mesh sweep to budget exhaustion."""
-        t0 = time.time()
+        t0 = time.monotonic()
         job = self.store.get_train_job(job_id)
         if job is None:
             raise KeyError(f"No train job {job_id!r}")
@@ -230,16 +230,18 @@ class MeshSweepScheduler:
             for sub in self.store.get_sub_train_jobs(job_id):
                 self.store.update_sub_train_job(
                     sub["id"], status=TrainJobStatus.ERRORED.value)
+            # lint: disable=RF007 — job duration emitted into the event/result below
+            dur_s = time.monotonic() - t0
             events.emit("train_job_finished", job_id=job_id,
                         status=TrainJobStatus.ERRORED.value,
-                        duration_s=round(time.time() - t0, 3),
+                        duration_s=round(dur_s, 3),
                         degraded=True)
             return TrainJobResult(
                 job_id=job_id,
                 status=TrainJobStatus.ERRORED.value,
                 trials=[],
                 best_trials=[],
-                duration_s=time.time() - t0,
+                duration_s=dur_s,
                 errors=["mesh sweep: no device obtainable"],
             )
         k = max(1, int(trials_per_chip))
@@ -294,16 +296,18 @@ class MeshSweepScheduler:
             status = TrainJobStatus.COMPLETED.value
         self.store.update_train_job_status(job_id, status)
         telemetry.inc("scheduler.train_jobs_finished")
-        telemetry.observe("scheduler.train_job_s", time.time() - t0)
+        # lint: disable=RF007 — job duration observed into train_job_s right here
+        dur_s = time.monotonic() - t0
+        telemetry.observe("scheduler.train_job_s", dur_s)
         events.emit("train_job_finished", job_id=job_id, status=status,
-                    duration_s=round(time.time() - t0, 3),
+                    duration_s=round(dur_s, 3),
                     degraded=degraded)
         return TrainJobResult(
             job_id=job_id,
             status=status,
             trials=self.store.get_trials_of_train_job(job_id),
             best_trials=self.store.get_best_trials_of_train_job(job_id, limit=2),
-            duration_s=time.time() - t0,
+            duration_s=dur_s,
             errors=errors,
         )
 

@@ -227,7 +227,7 @@ class ProcessScheduler:
         poll_s: float = 0.5,
         multihost_processes: int = 1,
     ) -> TrainJobResult:
-        t0 = time.time()
+        t0 = time.monotonic()
         job = self.store.get_train_job(job_id)
         if job is None:
             raise KeyError(f"No train job {job_id!r}")
@@ -276,7 +276,8 @@ class ProcessScheduler:
                                                TrainJobStatus.ERRORED.value)
             events.emit("train_job_finished", job_id=job_id,
                         status=TrainJobStatus.ERRORED.value,
-                        duration_s=round(time.time() - t0, 3))
+                        # lint: disable=RF007 — job duration emitted into the event itself
+                        duration_s=round(time.monotonic() - t0, 3))
             raise
         finally:
             server.shutdown()
@@ -292,13 +293,15 @@ class ProcessScheduler:
         else:
             status = TrainJobStatus.COMPLETED.value
         self.store.update_train_job_status(job_id, status)
+        # lint: disable=RF007 — job duration emitted into the event/result below
+        dur_s = time.monotonic() - t0
         events.emit("train_job_finished", job_id=job_id, status=status,
-                    duration_s=round(time.time() - t0, 3))
+                    duration_s=round(dur_s, 3))
         return TrainJobResult(
             job_id=job_id, status=status,
             trials=self.store.get_trials_of_train_job(job_id),
             best_trials=self.store.get_best_trials_of_train_job(job_id, limit=2),
-            duration_s=time.time() - t0, errors=errors)
+            duration_s=dur_s, errors=errors)
 
     def _spawn_group(self, g: _WorkerGroup, ctx: dict,
                      port: Optional[int] = None) -> None:

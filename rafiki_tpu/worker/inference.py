@@ -21,6 +21,7 @@ import numpy as np
 from rafiki_tpu import chaos, telemetry
 from rafiki_tpu.model.base import BaseModel
 from rafiki_tpu.obs import context as trace_context
+from rafiki_tpu.obs.anatomy import hops as _hops
 from rafiki_tpu.obs.journal import journal as _journal
 
 
@@ -33,6 +34,10 @@ class InferenceWorker:
         self.model = model
         self.batch_size = batch_size
         self._stop = stop_event or threading.Event()
+        # First successful forward on this worker pays the compile; the
+        # hop chain splits it out as forward_cold vs forward so a cold
+        # hit cannot masquerade as a warm-path tail.
+        self._warm = False
 
     HEARTBEAT_S = 0.5
 
@@ -71,6 +76,14 @@ class InferenceWorker:
                 traces = [item[2] if len(item) > 2 else None
                           for item in items]
                 lead = next((t for t in traces if t), None)
+                # Hop chains (docs/serving_anatomy.md): continue each
+                # traced query's envelope marks with this worker's leg.
+                # Batch-shared marks (deq/fwds/forward end) are stamped
+                # once and appended to every chain in the micro-batch.
+                deq = _hops.mark("deq")
+                chains = [list(tr["hops"]) + [deq]
+                          if tr and tr.get("hops") else None
+                          for tr in traces]
                 for qid, tr in zip(qids, traces):
                     if tr:
                         _journal.record(
@@ -80,6 +93,11 @@ class InferenceWorker:
                             parent_span=tr.get("parent_span"))
                 bind = (trace_context.trace(lead.get("trace_id")) if lead
                         else contextlib.nullcontext())
+                # fwds opens the forward segment BEFORE the chaos hook:
+                # an injected inference.forward delay must land inside
+                # the forward hop, where tail attribution can see it.
+                fwds = _hops.mark("fwds")
+                was_cold = not self._warm
                 try:
                     # Chaos: a delay here is a latency spike / stuck
                     # replica (the lease stays fresh — the beat thread
@@ -90,11 +108,20 @@ class InferenceWorker:
                                               worker_id=self.worker_id):
                         preds = self._predict(queries)
                     telemetry.inc("inference.queries_served", len(queries))
+                    self._warm = True
                 except Exception as e:  # a bad query batch must not kill the worker
                     telemetry.inc("inference.batch_errors")
                     preds = [{"error": str(e)}] * len(queries)
-                for qid, pred in zip(qids, preds):
-                    self.bus.put_prediction(qid, self.worker_id, pred)
+                fwd_end = _hops.mark("fwdc" if was_cold else "fwd")
+                for qid, pred, chain in zip(qids, preds, chains):
+                    if chain is None:
+                        self.bus.put_prediction(qid, self.worker_id, pred)
+                    else:
+                        chain.append(fwds)
+                        chain.append(fwd_end)
+                        chain.append(_hops.mark("reply"))
+                        self.bus.put_prediction(qid, self.worker_id, pred,
+                                                hops=chain)
         finally:
             self.bus.remove_worker(self.job_id, self.worker_id)
 

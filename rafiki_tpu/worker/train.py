@@ -158,6 +158,7 @@ class TrainWorker:
         if self._stop is not None and self._stop.is_set():
             return True
         hours = self.budget.get(BudgetType.TIME_HOURS.value)
+        # lint: disable=RF009 — job age vs a persisted epoch timestamp: job_created_at survives restarts, so wall clock is the only shared basis
         if hours is not None and time.time() - self.job_created_at >= float(hours) * 3600:
             return True
         return False

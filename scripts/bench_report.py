@@ -48,6 +48,23 @@ METRICS = {
     "compile_s": "lower",
 }
 
+#: Serving-round metrics (``--serving``): bench_serving.py v2 artifact
+#: keys with their polarities, so SERVING_r*.json rounds gate the
+#: trajectory exactly like training rounds do.
+SERVING_METRICS = {
+    "qps": "higher",
+    "p50_ms": "lower",
+    "p99_ms": "lower",
+    "shed_rate": "lower",
+    "ensemble_fanout_cost_ms": "lower",
+}
+
+#: Metrics where 0 is a legitimate measurement, not "did not run" —
+#: a clean serving round genuinely sheds nothing and a 1-worker round
+#: has zero fan-out cost. (Throughput-style metrics keep the strict
+#: v > 0 rule: their zeros mean a dead backend.)
+ZERO_OK = {"shed_rate", "ensemble_fanout_cost_ms"}
+
 
 def _payload_from_tail(tail: Any) -> Optional[Dict[str, Any]]:
     """Backfill path: no ``parsed`` block, so scan the captured stdout
@@ -84,8 +101,10 @@ def load_round(path: str) -> Dict[str, Any]:
     if not isinstance(doc, dict):
         out["error"] = "artifact is not a JSON object"
         return out
-    if "metric" in doc or "headline" in doc:
-        # A raw bench.py result line saved directly, no driver wrapper.
+    if ("metric" in doc or "headline" in doc
+            or "qps" in doc or "schema_version" in doc):
+        # A raw bench.py / bench_serving.py result saved directly, no
+        # driver wrapper.
         out["payload"], out["source"] = doc, "raw"
         return out
     out["round"] = doc.get("n", name)
@@ -117,6 +136,15 @@ def headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+def serving_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """The serving metric block: bench_serving.py v2 artifacts carry
+    the headline keys at top level."""
+    if not isinstance(payload, dict) or payload.get("error"):
+        return {}
+    return {k: payload.get(k) for k in SERVING_METRICS
+            if payload.get(k) is not None}
+
+
 def health_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     """The ``detail.health`` numerics block (docs/health.md), when the
     artifact carries one. Trended as ADVISORY context — a round with
@@ -128,21 +156,25 @@ def health_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     return h if isinstance(h, dict) else {}
 
 
-def _measurable(v: Any) -> bool:
-    return isinstance(v, (int, float)) and v > 0
+def _measurable(v: Any, zero_ok: bool = False) -> bool:
+    if not isinstance(v, (int, float)) or isinstance(v, bool):
+        return False
+    return v > 0 or (zero_ok and v == 0)
 
 
-def trend(rounds: List[Dict[str, Any]],
-          tolerance: float) -> Dict[str, Dict[str, Any]]:
+def trend(rounds: List[Dict[str, Any]], tolerance: float,
+          metrics: Optional[Dict[str, str]] = None,
+          headline_fn=headline_of) -> Dict[str, Dict[str, Any]]:
     """Per-metric trajectory + verdict. Latest measurable point vs the
     best prior measurable point, with a relative tolerance band."""
     out: Dict[str, Dict[str, Any]] = {}
-    for metric, direction in METRICS.items():
+    for metric, direction in (metrics or METRICS).items():
+        zero_ok = metric in ZERO_OK
         points = []
         for r in rounds:
-            v = headline_of(r["payload"]).get(metric)
+            v = headline_fn(r["payload"]).get(metric)
             points.append({"round": r["round"],
-                           "value": v if _measurable(v) else None})
+                           "value": v if _measurable(v, zero_ok) else None})
         measured = [p for p in points if p["value"] is not None]
         entry: Dict[str, Any] = {"direction": direction,
                                  "trajectory": points,
@@ -158,8 +190,12 @@ def trend(rounds: List[Dict[str, Any]],
             best = max(prior) if direction == "higher" else min(prior)
             # Signed fraction, positive = worse, in units of the best
             # prior value — one tolerance knob works for both signs.
+            # ZERO_OK metrics can have best == 0 (a clean round shed
+            # nothing): fall back to an absolute delta so going from
+            # 0 to anything still registers instead of dividing by 0.
+            denom = best if best > 0 else 1.0
             delta = ((best - latest) if direction == "higher"
-                     else (latest - best)) / best
+                     else (latest - best)) / denom
             entry.update({"latest": latest, "best_prior": best,
                           "delta_frac": round(delta, 4)})
             if delta > tolerance:
@@ -181,18 +217,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(default: BENCH_r*.json next to bench.py)")
     p.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
                    help="relative regression band (default 0.10)")
+    p.add_argument("--serving", action="store_true",
+                   help="trend bench_serving.py rounds (SERVING_r*.json "
+                        "default glob, qps/p50/p99/shed/fanout polarities)")
     args = p.parse_args(argv)
+
+    metric_set = SERVING_METRICS if args.serving else METRICS
+    headline_fn = serving_headline_of if args.serving else headline_of
+    pattern = "SERVING_r*.json" if args.serving else "BENCH_r*.json"
 
     paths = args.artifacts
     if not paths:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+        paths = sorted(glob.glob(os.path.join(root, pattern)))
     if not paths:
         print(json.dumps({"error": "no bench artifacts found"}))
         return 2
 
     rounds = [load_round(pth) for pth in paths]
-    metrics = trend(rounds, args.tolerance)
+    metrics = trend(rounds, args.tolerance,
+                    metrics=metric_set, headline_fn=headline_fn)
     regressed = sorted(m for m, e in metrics.items()
                        if e["verdict"] == "regressed")
     health_points = [dict(round=r["round"], **health_of(r["payload"]))
@@ -201,9 +245,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         "schema_version": REPORT_SCHEMA_VERSION,
         "tolerance": args.tolerance,
         "n_rounds": len(rounds),
+        "mode": "serving" if args.serving else "training",
         "rounds": [{"round": r["round"], "rc": r["rc"],
                     "source": r["source"],
-                    "has_data": bool(headline_of(r["payload"]))}
+                    "has_data": bool(headline_fn(r["payload"]))}
                    for r in rounds],
         "metrics": metrics,
         "health": {

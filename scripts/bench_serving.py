@@ -1,7 +1,7 @@
 #!/usr/bin/env python
-"""Closed-loop serving load generator for the predict path.
+"""Closed-loop serving load generator for the predict path (schema v2).
 
-Two modes:
+Three modes:
 
   * ``--url http://host:port`` — drive a LIVE predictor endpoint
     (``predictor_host`` from the inference-job row) with N closed-loop
@@ -12,11 +12,24 @@ Two modes:
     Gateway + PredictorApp WSGI stack, exercised through the werkzeug
     test client. No sockets, no sleeps beyond the stub service time —
     the tier-1 wiring in scripts/check_tier1.sh runs this variant.
+  * ``--smoke --mp`` — same stack, but the stub workers are REAL
+    spawned processes on the multiprocess bus, so the hop waterfall
+    crosses >=3 pids (scripts/serving_obs_smoke.py drives this).
 
-Output: one JSON object on stdout:
+Output: one JSON object on stdout (``schema_version: 2``):
 
-  {"qps": ..., "p50_ms": ..., "p99_ms": ..., "shed_rate": ...,
-   "requests": ..., "ok": ..., "shed": ..., "errors": ...}
+  {"schema_version": 2, "qps": ..., "p50_ms": ..., "p99_ms": ...,
+   "shed_rate": ..., "requests": ..., "ok": ..., "shed": ...,
+   "errors": ..., "hops": {"forward": {"count": ..., "p50_ms": ...,
+   "p99_ms": ...}, ...}, "ensemble_fanout_cost_ms": ...}
+
+The ``hops`` block is the per-segment anatomy from the request-anatomy
+plane (docs/serving_anatomy.md) and ``ensemble_fanout_cost_ms`` is the
+chain total minus the slowest device forward — the overhead the
+k-replica fan-out adds on top of the model, i.e. the number the
+vmapped-ensemble bet must shrink. ``--pin-trace ID`` sends one extra
+traced request after the load so a known trace id has a full
+waterfall (``obs waterfall ID``).
 
 Closed-loop means each client fires its next request only after the
 previous one answered (or was shed) — offered load adapts to service
@@ -39,6 +52,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+SCHEMA_VERSION = 2
+
 
 def percentile(sorted_xs, p):
     if not sorted_xs:
@@ -47,11 +62,39 @@ def percentile(sorted_xs, p):
     return sorted_xs[min(last, int(last * p / 100))]
 
 
+class _StubModel:
+    """Fixed service time, fixed output — no jax, no compile. Module
+    level so multiprocessing spawn targets can pickle it."""
+
+    def __init__(self, service_ms):
+        self.service_ms = service_ms
+
+    def predict(self, queries):
+        time.sleep(self.service_ms / 1000.0)
+        return [[0.6, 0.4] for _ in queries]
+
+
+def _mp_stub_worker(bus, worker_id, service_ms):
+    """Spawn target: one stub inference worker as its OWN process, the
+    same dance run_inference_worker_process does (platform pin first,
+    then the obs plane) minus the model store."""
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()
+    from rafiki_tpu import obs
+
+    obs.configure_from_env(role="infer")
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    InferenceWorker(bus, "bench", worker_id,
+                    _StubModel(service_ms)).run()
+
+
 class ClosedLoopClient:
     """One closed-loop worker: POST, record, repeat."""
 
     def __init__(self, post, n_requests, payload, record):
-        self._post = post          # (payload) -> (status_code, latency_s)
+        self._post = post          # (payload) -> status_code
         self._n = n_requests
         self._payload = payload
         self._record = record
@@ -63,6 +106,7 @@ class ClosedLoopClient:
                 status = self._post(self._payload)
             except Exception:
                 status = -1
+            # lint: disable=RF007 — the delta IS the datum: the client-observed request latency this bench reports
             self._record(status, time.monotonic() - t0)
 
 
@@ -113,7 +157,34 @@ def run_load(post, n_clients, requests_per_client, payload):
         th.start()
     for th in threads:
         th.join()
+    # lint: disable=RF007 — the delta IS the datum: total load-generation wall used as the qps denominator
     return recorder.report(time.monotonic() - t0)
+
+
+def _hops_block():
+    """The per-segment anatomy block from this process's telemetry
+    registry (the predictor absorbs chains in-process, so the
+    histograms live here)."""
+    from rafiki_tpu import telemetry
+    from rafiki_tpu.obs.anatomy import hops as _hops
+
+    hists = telemetry.snapshot().get("histograms", {})
+    prefix = "serving.hop."
+    hops = {}
+    for name in sorted(hists):
+        if not name.startswith(prefix):
+            continue
+        h = hists[name]
+        seg = name[len(prefix):-2]  # strip prefix and the "_s" unit
+        hops[seg] = {"count": h.get("count"),
+                     "p50_ms": (None if h.get("p50") is None
+                                else round(h["p50"] * 1000, 3)),
+                     "p99_ms": (None if h.get("p99") is None
+                                else round(h["p99"] * 1000, 3))}
+    fan = hists.get(_hops.FANOUT_METRIC)
+    fanout_ms = (None if not fan or fan.get("p50") is None
+                 else round(fan["p50"] * 1000, 3))
+    return hops or None, fanout_ms
 
 
 def run_url_mode(args):
@@ -134,29 +205,40 @@ def run_url_mode(args):
 def run_smoke_mode(args):
     from werkzeug.test import Client
 
-    from rafiki_tpu.bus import InProcBus
     from rafiki_tpu.gateway import Gateway, GatewayConfig
     from rafiki_tpu.predictor import Predictor
     from rafiki_tpu.predictor.app import PredictorApp
     from rafiki_tpu.worker.inference import InferenceWorker
 
-    class StubModel:
-        """Fixed service time, fixed output — no jax, no compile."""
-
-        def predict(self, queries):
-            time.sleep(args.service_ms / 1000.0)
-            return [[0.6, 0.4] for _ in queries]
-
-    bus = InProcBus()
     stop = threading.Event()
     threads = []
-    for i in range(args.workers):
-        w = InferenceWorker(bus, "bench", f"bw{i}", StubModel(),
-                            stop_event=stop)
-        th = threading.Thread(target=w.run, daemon=True)
-        threads.append(th)
-        th.start()
-    deadline = time.monotonic() + 10
+    procs = []
+    manager = None
+    if args.mp:
+        import multiprocessing as mp
+
+        from rafiki_tpu.bus.queues import make_mp_bus
+
+        ctx = mp.get_context("spawn")
+        manager = ctx.Manager()
+        bus = make_mp_bus(manager)
+        for i in range(args.workers):
+            pr = ctx.Process(target=_mp_stub_worker,
+                             args=(bus, f"bw{i}", args.service_ms),
+                             daemon=True)
+            procs.append(pr)
+            pr.start()
+    else:
+        from rafiki_tpu.bus import InProcBus
+
+        bus = InProcBus()
+        for i in range(args.workers):
+            w = InferenceWorker(bus, "bench", f"bw{i}",
+                                _StubModel(args.service_ms), stop_event=stop)
+            th = threading.Thread(target=w.run, daemon=True)
+            threads.append(th)
+            th.start()
+    deadline = time.monotonic() + (30 if args.mp else 10)
     while len(bus.get_workers("bench")) < args.workers:
         if time.monotonic() > deadline:
             raise RuntimeError("bench workers never registered")
@@ -165,7 +247,7 @@ def run_smoke_mode(args):
     predictor = Predictor(bus, "bench", timeout_s=args.deadline_s)
     gateway = Gateway(predictor, GatewayConfig(
         max_inflight=args.max_inflight, max_queue=args.max_queue,
-        hedge_grace_s=0.02))
+        min_replies=args.min_replies, hedge_grace_s=0.02))
     wsgi = Client(PredictorApp(gateway))
 
     def post(payload):
@@ -174,11 +256,39 @@ def run_smoke_mode(args):
     payload = {"queries": [[1.0]] * args.queries_per_request,
                "deadline_s": args.deadline_s}
     try:
-        return run_load(post, args.clients, args.requests_per_client, payload)
+        report = run_load(post, args.clients, args.requests_per_client,
+                          payload)
+        if args.pin_trace:
+            # One traced request AFTER the load: a known trace id with
+            # a full waterfall for `obs waterfall <id>` (retried — the
+            # pinned trace is the smoke's evidence, not a sample).
+            status = None
+            for _ in range(20):
+                status = wsgi.post(
+                    "/predict", json=payload,
+                    headers={"X-Rafiki-Trace-Id": args.pin_trace},
+                ).status_code
+                if status == 200:
+                    break
+                time.sleep(0.05)
+            report["pinned_trace"] = args.pin_trace
+            report["pinned_status"] = status
+        # Short runs would otherwise journal nothing: force the
+        # time-series bucket and the exemplar window closed.
+        gateway.rollup.flush()
+        from rafiki_tpu.obs.anatomy import exemplars
+
+        exemplars.ring.flush()
+        return report
     finally:
         stop.set()
         for th in threads:
             th.join(timeout=2)
+        for pr in procs:
+            pr.terminate()
+            pr.join(timeout=5)
+        if manager is not None:
+            manager.shutdown()
 
 
 def main(argv=None):
@@ -194,6 +304,12 @@ def main(argv=None):
                                   "in-process smoke run")
     ap.add_argument("--smoke", action="store_true",
                     help="force the in-process deterministic run")
+    ap.add_argument("--mp", action="store_true",
+                    help="smoke mode with REAL spawned worker processes "
+                         "on the mp bus (cross-process waterfalls)")
+    ap.add_argument("--pin-trace", default=None,
+                    help="send one extra request under this trace id "
+                         "after the load (obs waterfall target)")
     ap.add_argument("--clients", type=int, default=4)
     ap.add_argument("--requests-per-client", type=int, default=25)
     ap.add_argument("--queries-per-request", type=int, default=4)
@@ -204,14 +320,27 @@ def main(argv=None):
                     help="stub model service time (smoke mode)")
     ap.add_argument("--max-inflight", type=int, default=4)
     ap.add_argument("--max-queue", type=int, default=8)
+    ap.add_argument("--min-replies", type=int, default=None,
+                    help="gather quorum override (default ceil(k/2))")
     args = ap.parse_args(argv)
+
+    # Journal under RAFIKI_LOG_DIR when set: the serving/ts, serving/
+    # hops and slo records are this bench's durable side channel.
+    from rafiki_tpu import obs
+
+    obs.configure_from_env(role="gateway")
 
     if args.url and not args.smoke:
         report = run_url_mode(args)
         report["mode"] = "url"
     else:
         report = run_smoke_mode(args)
-        report["mode"] = "smoke"
+        report["mode"] = "smoke-mp" if args.mp else "smoke"
+
+    report["schema_version"] = SCHEMA_VERSION
+    hops, fanout_ms = _hops_block()
+    report["hops"] = hops
+    report["ensemble_fanout_cost_ms"] = fanout_ms
 
     print(json.dumps(report, indent=2))
 

@@ -52,6 +52,13 @@ env JAX_PLATFORMS=cpu python scripts/mesh_smoke.py > /tmp/_mesh_smoke.json \
 # fresh process (docs/health.md). ~13s.
 env JAX_PLATFORMS=cpu python scripts/health_smoke.py > /tmp/_health_smoke.json \
   || { echo "TIER1 HEALTH SMOKE FAILED (see /tmp/_health_smoke.json)"; exit 1; }
+# Request-anatomy smoke: a clean mp run must reconstruct a pinned
+# >=4-hop waterfall across >=3 pids with hop sums reconciling, and an
+# injected inference.forward delay must be attributed to the forward
+# hop by `obs tails` AND breach its latency-budget SLO
+# (docs/serving_anatomy.md).
+env JAX_PLATFORMS=cpu python scripts/serving_obs_smoke.py > /tmp/_serving_obs_smoke.json \
+  || { echo "TIER1 SERVING OBS SMOKE FAILED (see /tmp/_serving_obs_smoke.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

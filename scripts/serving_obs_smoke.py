@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Request-anatomy CI smoke: waterfalls, tail attribution, both polarities.
+
+Three phases, each in a fresh subprocess + journal dir
+(docs/serving_anatomy.md):
+
+  1. **Clean mp run** — ``bench_serving --smoke --mp`` with REAL
+     spawned stub workers on the multiprocess bus and one pinned trace
+     id. The artifact must be schema v2 with a populated ``hops``
+     block, and the real ``obs waterfall <pin>`` CLI must reconstruct
+     the pinned trace with >=4 hops spanning >=3 distinct pids, every
+     chain's hop sums reconciling with its end-to-end span within 10%
+     (``obs tails --check`` enforces the same fleet-wide). The
+     serving time-series must have journaled rows (``obs serving``)
+     and the ``serving_forward_p99`` SLO must NOT have breached — the
+     no-false-positive control for phase 2.
+
+  2. **Injected mp run** — same stack, chaos plane now delaying
+     ``inference.forward`` by 250ms on ~20% of batches, with a tight
+     custom ``serving_forward_p99`` budget (150ms) ticking every
+     100ms. ``obs tails`` must attribute the tail to the ``forward``
+     hop (dominant segment), and the journals must carry the
+     ``slo/breach`` record for ``serving_forward_p99`` — the injected
+     delay is both *localised* and *alarmed*. The load is shaped so
+     attribution is crisp, not smeared: one closed-loop client with
+     one query per request makes every micro-batch a single query, so
+     both replicas' chaos RNG streams (seeded, advanced once per hit)
+     stay aligned and a delayed request delays BOTH replicas — the
+     partner chain never mirrors the delay into its gather_decide
+     wait, and p=0.2 keeps the delay out of the forward p50.
+
+  3. **Report gate, both polarities** — ``bench_report --serving``
+     over synthetic SERVING_r*.json rounds: an improved round must
+     exit 0, a regressed round must exit 1. Serving rounds gate the
+     trajectory exactly like training rounds.
+
+Output: one JSON object on stdout. Exit code: 0 when every assertion
+holds; 1 otherwise — this is a CI gate (scripts/check_tier1.sh).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PIN = "cafe0bet4p5"  # pinned trace id: the smoke's evidence, not a sample
+CHAOS = "seed=7;inference.forward:delay:delay=0.25:p=0.2"
+TIGHT_SLO = json.dumps([{
+    "name": "serving_forward_p99",
+    "source": "hist_p99:serving.hop.forward_s",
+    "threshold": 0.15,
+    "windows": [0.4, 1.0],
+    "description": "smoke: forward p99 budget tightened to 150ms",
+}])
+
+
+def _run(cmd, env=None, timeout=300):
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          env=full_env, timeout=timeout, cwd=REPO)
+
+
+def _bench(log_dir, extra_env=None, pin=None, extra_args=()):
+    cmd = [sys.executable, "scripts/bench_serving.py", "--smoke", "--mp",
+           "--min-replies", "2", *extra_args]
+    if pin:
+        cmd += ["--pin-trace", pin]
+    env = {"RAFIKI_LOG_DIR": log_dir}
+    if extra_env:
+        env.update(extra_env)
+    r = _run(cmd, env=env)
+    try:
+        report = json.loads(r.stdout)
+    except ValueError:
+        report = {"unparseable_stdout": r.stdout[-500:]}
+    return r.returncode, report, r.stderr[-500:]
+
+
+def _obs(log_dir, *verb_args):
+    return _run([sys.executable, "-m", "rafiki_tpu.obs",
+                 "--dir", log_dir, "--json", *verb_args])
+
+
+def _journal_records(log_dir):
+    out = []
+    for name in os.listdir(log_dir):
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(log_dir, name)) as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass
+    return out
+
+
+def phase_clean(results):
+    log_dir = tempfile.mkdtemp(prefix="serving_smoke_clean_")
+    rc, report, err = _bench(log_dir, pin=PIN,
+                             extra_args=("--requests-per-client", "12"))
+    ph = {"bench_rc": rc, "bench_stderr": err,
+          "schema_version": report.get("schema_version"),
+          "pinned_status": report.get("pinned_status"),
+          "hops_segments": sorted(report.get("hops") or {}),
+          "ensemble_fanout_cost_ms": report.get("ensemble_fanout_cost_ms")}
+    ok = (rc == 0 and report.get("schema_version") == 2
+          and report.get("pinned_status") == 200
+          and bool(report.get("hops")))
+
+    # The pinned trace through the REAL CLI: >=4 hops, >=3 pids, and
+    # hop sums reconciling with the chain span within 10%.
+    wf = _obs(log_dir, "waterfall", PIN)
+    ph["waterfall_rc"] = wf.returncode
+    queries = []
+    if wf.returncode == 0:
+        try:
+            queries = json.loads(wf.stdout).get("queries", [])
+        except ValueError:
+            pass
+    if queries:
+        ph["waterfall"] = {
+            "queries": len(queries),
+            "min_hops": min(q.get("n_hops", 0) for q in queries),
+            "pids": sorted({p for q in queries for p in q.get("pids", [])}),
+            "max_reconcile_err": max(q.get("max_reconcile_err", 1.0)
+                                     for q in queries),
+        }
+        w = ph["waterfall"]
+        ok = (ok and w["min_hops"] >= 4 and len(w["pids"]) >= 3
+              and w["max_reconcile_err"] <= 0.10)
+    else:
+        ok = False
+
+    tails = _obs(log_dir, "tails", "--check", "--tolerance", "0.10")
+    ph["tails_check_rc"] = tails.returncode
+    ok = ok and tails.returncode == 0
+
+    serving = _obs(log_dir, "serving")
+    rows = [ln for ln in serving.stdout.splitlines() if ln.strip()]
+    ph["serving_rc"], ph["serving_rows"] = serving.returncode, len(rows)
+    ok = ok and serving.returncode == 0 and rows
+
+    # No-false-positive control: the default 1s forward budget must
+    # not breach on ~millisecond stub forwards.
+    breaches = [r for r in _journal_records(log_dir)
+                if r.get("kind") == "slo" and r.get("name") == "breach"
+                and r.get("slo") == "serving_forward_p99"]
+    ph["forward_breaches"] = len(breaches)
+    ok = ok and not breaches
+
+    ph["ok"] = bool(ok)
+    results["clean"] = ph
+    return ok
+
+
+def phase_injected(results):
+    log_dir = tempfile.mkdtemp(prefix="serving_smoke_chaos_")
+    rc, report, err = _bench(
+        log_dir,
+        extra_args=("--clients", "1", "--queries-per-request", "1",
+                    "--requests-per-client", "80"),
+        extra_env={
+            "RAFIKI_CHAOS": CHAOS,
+            "RAFIKI_SLO": TIGHT_SLO,
+            "RAFIKI_SLO_TICK_S": "0.1",
+        })
+    ph = {"bench_rc": rc, "bench_stderr": err,
+          "p99_ms": report.get("p99_ms")}
+    ok = rc == 0
+
+    # Attribution: the injected delay must surface as the forward hop
+    # dominating the p99-over-p50 excess.
+    tails = _obs(log_dir, "tails")
+    ph["tails_rc"] = tails.returncode
+    dominant = None
+    if tails.returncode == 0:
+        try:
+            doc = json.loads(tails.stdout)
+            dominant = doc.get("dominant")
+            ph["dominant"] = dominant
+            ph["forward_excess_ms"] = next(
+                (s.get("excess_ms") for s in doc.get("segments", [])
+                 if s.get("segment") == "forward"), None)
+        except ValueError:
+            pass
+    ok = ok and bool(dominant) and dominant.startswith("forward")
+
+    # Alarm: the tightened 150ms budget must have breached and left a
+    # slo/breach record behind.
+    breaches = [r for r in _journal_records(log_dir)
+                if r.get("kind") == "slo" and r.get("name") == "breach"
+                and r.get("slo") == "serving_forward_p99"]
+    ph["forward_breaches"] = len(breaches)
+    ok = ok and bool(breaches)
+
+    ph["ok"] = bool(ok)
+    results["injected"] = ph
+    return ok
+
+
+def phase_report_gate(results):
+    d = tempfile.mkdtemp(prefix="serving_smoke_report_")
+
+    def _round(n, **kv):
+        path = os.path.join(d, f"SERVING_r{n:02d}.json")
+        with open(path, "w") as f:
+            json.dump(dict(schema_version=2, **kv), f)
+        return path
+
+    base = _round(1, qps=100.0, p50_ms=10.0, p99_ms=30.0, shed_rate=0.0,
+                  ensemble_fanout_cost_ms=5.0)
+    better = _round(2, qps=130.0, p50_ms=7.0, p99_ms=22.0, shed_rate=0.0,
+                    ensemble_fanout_cost_ms=3.0)
+    worse = _round(3, qps=60.0, p50_ms=18.0, p99_ms=80.0, shed_rate=0.2,
+                   ensemble_fanout_cost_ms=15.0)
+
+    good = _run([sys.executable, "scripts/bench_report.py", "--serving",
+                 base, better])
+    bad = _run([sys.executable, "scripts/bench_report.py", "--serving",
+                base, better, worse])
+    try:
+        regressed = json.loads(bad.stdout).get("regressed")
+    except ValueError:
+        regressed = None
+    ph = {"improved_rc": good.returncode, "regressed_rc": bad.returncode,
+          "regressed_metrics": regressed}
+    ok = (good.returncode == 0 and bad.returncode == 1
+          and bool(regressed))
+    ph["ok"] = bool(ok)
+    results["report_gate"] = ph
+    return ok
+
+
+def main():
+    results = {}
+    ok = phase_clean(results)
+    ok = phase_injected(results) and ok
+    ok = phase_report_gate(results) and ok
+    results["ok"] = bool(ok)
+    print(json.dumps(results, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,7 +59,7 @@ def probe() -> tuple[bool, str]:
 
 
 def _run(label: str, cmd: list[str], timeout_s: float) -> tuple[int, str, str]:
-    t0 = time.time()
+    t0 = time.monotonic()
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout_s, cwd=REPO)
@@ -69,7 +69,8 @@ def _run(label: str, cmd: list[str], timeout_s: float) -> tuple[int, str, str]:
         out = (ex.stdout or b"").decode("utf-8", "replace") \
             if isinstance(ex.stdout, bytes) else (ex.stdout or "")
         err = f"timed out after {timeout_s:.0f}s"
-    _log({"event": label, "rc": rc, "wall_s": round(time.time() - t0, 1),
+    _log({"event": label, "rc": rc,
+          "wall_s": round(time.monotonic() - t0, 1),
           "stderr_tail": err.strip()[-300:]})
     return rc, out, err
 

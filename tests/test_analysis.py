@@ -29,7 +29,7 @@ def _analyze_snippet(tmp_path, source, name="snippet.py", select=None):
 
 def test_all_builtin_checkers_registered():
     assert {"RF001", "RF002", "RF003", "RF004", "RF005",
-            "RF006", "RF007", "RF008"} <= set(REGISTRY)
+            "RF006", "RF007", "RF008", "RF009"} <= set(REGISTRY)
 
 
 # ---------------------------------------------------------------------------
@@ -606,6 +606,64 @@ def test_rf008_current_tree_is_clean():
                        os.path.join(REPO, "bench.py"),
                        os.path.join(REPO, "scripts")], select=["RF008"])
     mine = [f for f in r.unsuppressed if f.checker_id == "RF008"]
+    assert mine == [], [f"{f.path}:{f.line}" for f in mine]
+
+
+# ---------------------------------------------------------------------------
+# RF009 wall-clock-duration
+# ---------------------------------------------------------------------------
+
+
+def test_rf009_fires_on_wall_clock_delta(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        import time
+
+        def measure(work):
+            t0 = time.time()
+            work()
+            return time.time() - t0
+        """)
+    found = [f for f in r.unsuppressed if f.checker_id == "RF009"]
+    assert len(found) == 1 and "monotonic" in found[0].message
+
+
+def test_rf009_quiet_on_legal_wall_clock_shapes(tmp_path):
+    # deadline - time.time() (remaining budget against an absolute
+    # cutoff), bare timestamps, and monotonic deltas are all fine.
+    r = _analyze_snippet(tmp_path, """
+        import time
+
+        def remaining(deadline):
+            return deadline - time.time()
+
+        def stamp(rec):
+            rec["ts"] = time.time()
+            return rec
+
+        def measure(work):
+            t0 = time.monotonic()
+            work()
+            return time.monotonic() - t0
+        """)
+    assert "RF009" not in _ids(r)
+
+
+def test_rf009_justified_suppression_honored(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        import time
+
+        def lease_cutoff(max_age_s):
+            # lint: disable=RF009 — cutoff vs cross-process wall-clock beats
+            return time.time() - max_age_s
+        """)
+    assert "RF009" not in _ids(r)
+
+
+def test_rf009_current_tree_is_clean():
+    r = analyze_paths([os.path.join(REPO, "rafiki_tpu"),
+                       os.path.join(REPO, "bench.py"),
+                       os.path.join(REPO, "scripts")], select=["RF009"])
+    mine = [f for f in r.unsuppressed if f.checker_id == "RF009"]
     assert mine == [], [f"{f.path}:{f.line}" for f in mine]
 
 

@@ -1,0 +1,297 @@
+"""Request-anatomy plane (docs/serving_anatomy.md): hop-mark envelope
+back-compat, segment math and hop-sum reconciliation, the exemplar
+ring's bounds, the serving rollup's determinism, and waterfall
+stitching across processes via the real CLI readers."""
+
+import json
+
+import pytest
+
+from rafiki_tpu import telemetry
+from rafiki_tpu.bus import InProcBus
+from rafiki_tpu.obs import context as trace_context
+from rafiki_tpu.obs import journal as journal_mod
+from rafiki_tpu.obs.anatomy import hops
+from rafiki_tpu.obs.anatomy.exemplars import ExemplarRing
+from rafiki_tpu.obs.anatomy.timeseries import ServingRollup
+from rafiki_tpu.obs.journal import journal
+
+
+@pytest.fixture
+def journaled(tmp_path):
+    journal.configure(tmp_path, role="test")
+    try:
+        yield tmp_path
+    finally:
+        journal.close()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- envelope back-compat ----------------------------------------------------
+
+
+def test_untraced_messages_keep_bare_tuple_shapes():
+    bus = InProcBus()
+    bus.add_worker("job", "w0")
+    bus.add_query("w0", "q1", [1.0])
+    (item,) = bus.pop_queries("w0", max_n=4, timeout=0.5)
+    assert item == ("q1", [1.0])  # no trace -> no third element
+    bus.put_prediction("q1", "w0", [0.5])
+    (reply,) = bus.get_predictions("q1", n=1, timeout=0.5)
+    assert reply == ("w0", [0.5])
+
+
+def test_traced_envelope_carries_gateway_prefix_plus_enq_mark():
+    bus = InProcBus()
+    bus.add_worker("job", "w0")
+    hops.begin()
+    hops.add("admit")
+    hops.add("queue")
+    try:
+        with trace_context.trace("t-anatomy-1"):
+            bus.add_query("w0", "q1", [1.0])
+    finally:
+        hops.clear()
+    (item,) = bus.pop_queries("w0", max_n=4, timeout=0.5)
+    assert item[0] == "q1" and len(item) == 3
+    marks = item[2]["hops"]
+    assert [m[0] for m in marks] == ["admit", "queue", "enq"]
+    # [code, monotonic ts, pid]: timestamps ordered, pid stamped
+    assert marks[0][1] <= marks[-1][1]
+    assert all(isinstance(m[2], int) for m in marks)
+    # clear() closed the prefix: the next add is a no-op
+    assert hops.add("admit") is None and hops.prefix_marks() == []
+
+
+def test_explicit_trace_dict_is_not_mutated_by_envelope():
+    bus = InProcBus()
+    bus.add_worker("job", "w0")
+    shared = {"trace_id": "t-shared"}
+    bus.add_query("w0", "q1", [1.0], trace=shared)
+    assert "hops" not in shared  # caller-owned dict copied, not annotated
+    (item,) = bus.pop_queries("w0", max_n=4, timeout=0.5)
+    assert item[2]["trace_id"] == "t-shared"
+    assert [m[0] for m in item[2]["hops"]] == ["enq"]
+
+
+def test_reply_hops_ride_as_optional_third_element():
+    bus = InProcBus()
+    bus.add_worker("job", "w0")
+    bus.add_worker("job", "w1")
+    chain = [hops.mark("enq"), hops.mark("deq"), hops.mark("reply")]
+    bus.put_prediction("q1", "w0", [0.5], hops=chain)
+    bus.put_prediction("q1", "w1", [0.4])
+    replies = sorted(bus.get_predictions("q1", n=2, timeout=0.5),
+                     key=lambda item: item[0])
+    # Mixed shapes gather together: consumers index, never destructure.
+    assert [len(item) for item in replies] == [3, 2]
+    assert replies[0][2] is chain
+
+
+# -- segment math + reconciliation -------------------------------------------
+
+
+def _chain(pid, *steps):
+    """Build a mark chain from (code, ts) steps with a fixed pid."""
+    return [[code, float(ts), pid] for code, ts in steps]
+
+
+FULL = (("admit", 0.0), ("queue", 0.010), ("enq", 0.012), ("deq", 0.020),
+        ("fwds", 0.021), ("fwd", 0.071), ("reply", 0.072), ("dec", 0.080))
+
+
+def test_segments_name_every_gap_and_sum_to_chain_total():
+    marks = _chain(42, *FULL)
+    segs = hops.segments(marks)
+    assert [s for s, _ in segs] == ["admission_wait", "route", "bus_queue",
+                                    "batch_wait", "forward", "reply_publish",
+                                    "gather_decide"]
+    assert sum(d for _, d in segs) == pytest.approx(
+        hops.chain_total_s(marks), abs=1e-9)
+
+
+def test_unknown_mark_breaks_reconciliation_loudly():
+    # A foreign mark advances the clock but names no segment: the
+    # hop-sum must fall SHORT of the end-to-end span, never silently
+    # absorb the gap into a neighbor.
+    marks = _chain(42, ("enq", 0.0), ("mystery", 0.5), ("dec", 0.6))
+    segs = hops.segments(marks)
+    assert [s for s, _ in segs] == ["gather_decide"]
+    assert sum(d for _, d in segs) == pytest.approx(0.1, abs=1e-9)
+    assert hops.chain_total_s(marks) == pytest.approx(0.6, abs=1e-9)
+
+
+def test_absorb_feeds_hop_histograms_and_fanout_cost(journaled):
+    fast = _chain(7, *FULL)
+    slow = _chain(8, ("enq", 0.012), ("deq", 0.020), ("fwds", 0.021),
+                  ("fwdc", 0.171), ("reply", 0.172), ("dec", 0.180))
+    total = hops.absorb("q-abs", {"w0": fast, "w1": slow})
+    assert total == pytest.approx(0.180 - 0.012)
+    hists = telemetry.snapshot()["histograms"]
+    assert hists["serving.hop.forward_s"]["count"] == 1
+    assert hists["serving.hop.forward_cold_s"]["count"] == 1
+    assert hists["serving.hop.bus_queue_s"]["count"] == 2
+    # fan-out cost = slowest chain total minus slowest device forward
+    fan = hists[hops.FANOUT_METRIC]
+    assert fan["count"] == 1
+    assert fan["p50"] == pytest.approx((0.180 - 0.012) - 0.150, abs=1e-6)
+    recs = [r for r in journal_mod.read_dir(journaled)
+            if r["kind"] == "serving" and r["name"] == "hops"]
+    assert len(recs) == 1 and recs[0]["query_id"] == "q-abs"
+    assert set(recs[0]["chains"]) == {"w0", "w1"}
+
+
+# -- exemplar ring ------------------------------------------------------------
+
+
+def test_exemplar_ring_keeps_slowest_n_and_rolls_windows(journaled):
+    clock = _Clock()
+    ring = ExemplarRing(cap=3, window_s=10.0, clock=clock)
+    for i, total in enumerate([0.05, 0.9, 0.1, 0.7, 0.3]):
+        ring.offer(total, {"query_id": f"q{i}", "chains": {},
+                           "trace_id": f"t{i}"})
+    col = ring.collector()
+    assert col["retained"] == 3 and col["offered"] == 5
+    assert col["slowest_s"] == pytest.approx(0.9)
+    # All-numeric leaves: the prom flattener must keep every field.
+    assert all(isinstance(v, (int, float)) for v in col.values())
+
+    # Window roll: the NEXT offer past window_s journals the retained
+    # slowest-first, with the trace id captured at OFFER time.
+    clock.t = 11.0
+    ring.offer(0.2, {"query_id": "q5", "chains": {}, "trace_id": "t5"})
+    recs = [r for r in journal_mod.read_dir(journaled)
+            if r["kind"] == "serving" and r["name"] == "exemplar"]
+    assert [r["query_id"] for r in recs] == ["q1", "q3", "q4"]
+    assert [r["rank"] for r in recs] == [0, 1, 2]
+    assert [r["trace_id"] for r in recs] == ["t1", "t3", "t4"]
+    assert ring.collector()["retained"] == 1  # the new window's offer
+    assert ring.flush() == 1
+    assert ring.collector()["windows_flushed"] == 2
+
+
+# -- serving rollup -----------------------------------------------------------
+
+
+def test_rollup_rows_are_deterministic_under_a_fake_clock(journaled):
+    clock = _Clock(100.2)
+    ctx = {"queue_depth": 3, "inflight": 2}
+    rollup = ServingRollup(bucket_s=1.0, clock=clock, context_fn=lambda: ctx)
+    for lat in (0.010, 0.020, 0.030, 0.250):
+        rollup.observe(latency_s=lat)
+    rollup.observe(outcome="shed")
+    rollup.observe(outcome="error")
+    clock.t = 101.2  # next bucket: first observe there closes the last
+    rollup.observe(latency_s=0.005)
+    rollup.flush()
+    rows = [r for r in journal_mod.read_dir(journaled)
+            if r["kind"] == "serving" and r["name"] == "ts"]
+    assert len(rows) == 2
+    first = rows[0]
+    assert (first["bucket"], first["requests"], first["ok"], first["shed"],
+            first["errors"]) == (100, 6, 4, 1, 1)
+    assert first["qps"] == pytest.approx(6.0)
+    # nearest-rank on [10, 20, 30, 250]ms: round(0.5 * 3) = idx 2
+    assert first["p50_ms"] == pytest.approx(30.0)
+    assert first["p99_ms"] == pytest.approx(250.0)
+    assert first["shed_rate"] == pytest.approx(1 / 6, abs=1e-4)
+    assert first["queue_depth"] == 3 and first["inflight"] == 2
+    gauges = telemetry.snapshot()["gauges"]
+    assert gauges["serving.qps"] == pytest.approx(1.0)  # the flushed bucket
+    col = rollup.collector()
+    assert col["buckets_flushed"] == 2
+    assert col["last"]["requests"] == 1
+
+
+def test_rollup_empty_bucket_journals_nothing(journaled):
+    rollup = ServingRollup(bucket_s=1.0, clock=_Clock())
+    assert rollup.flush() is None
+    assert [r for r in journal_mod.read_dir(journaled)
+            if r["kind"] == "serving"] == []
+
+
+# -- waterfall stitching across processes (the CLI readers) -------------------
+
+
+def _write_journal(tmp_path, name, records):
+    with open(tmp_path / name, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_waterfall_stitches_three_pids_and_reconciles(tmp_path, capsys):
+    from rafiki_tpu.obs import cli
+
+    # Hand-written journals from three processes: the gateway journaled
+    # the hops record (absorb runs in the gateway/predictor process)
+    # with chains whose marks were stamped by gateway pid 100 and the
+    # two worker pids 101/102.
+    chain_a = (_chain(100, ("admit", 0.0), ("queue", 0.010), ("enq", 0.012))
+               + _chain(101, ("deq", 0.020), ("fwds", 0.021), ("fwd", 0.071),
+                        ("reply", 0.072))
+               + _chain(100, ("dec", 0.080)))
+    chain_b = (_chain(100, ("admit", 0.0), ("queue", 0.010), ("enq", 0.012))
+               + _chain(102, ("deq", 0.025), ("fwds", 0.026), ("fwd", 0.076),
+                        ("reply", 0.077))
+               + _chain(100, ("dec", 0.080)))
+    _write_journal(tmp_path, "journal-gateway-100.jsonl", [
+        {"ts": 1.0, "pid": 100, "kind": "serving", "name": "hops",
+         "trace_id": "feedface01", "query_id": "q-wf",
+         "chains": {"w0": chain_a, "w1": chain_b}, "total_s": 0.08},
+        {"ts": 1.1, "pid": 100, "kind": "serving", "name": "request",
+         "trace_id": "feedface01", "queries": 1, "e2e_s": 0.081, "ok": True},
+    ])
+    _write_journal(tmp_path, "journal-infer-101.jsonl", [
+        {"ts": 0.9, "pid": 101, "kind": "bus", "name": "pop_query",
+         "trace_id": "feedface01", "query_id": "q-wf"},
+    ])
+
+    assert cli.cmd_waterfall(str(tmp_path), "feedface", as_json=True) == 0
+    doc = json.loads(capsys.readouterr().out)
+    (q,) = doc["queries"]
+    assert q["n_hops"] == 8
+    assert q["pids"] == [100, 101, 102]
+    assert q["max_reconcile_err"] <= 1e-9
+    assert doc["e2e_s"] == pytest.approx(0.081)
+
+    # Tail attribution over the same records reconciles fleet-wide.
+    assert cli.cmd_tails(str(tmp_path), as_json=True, check=True,
+                         tolerance=0.10) == 0
+    tails = json.loads(capsys.readouterr().out)
+    assert tails["reconcile"]["ok"] is True
+    assert {s["segment"] for s in tails["segments"]} >= {"forward",
+                                                         "bus_queue"}
+
+
+def test_waterfall_unknown_trace_exits_nonzero(tmp_path, capsys):
+    from rafiki_tpu.obs import cli
+
+    assert cli.cmd_waterfall(str(tmp_path), "nope", as_json=True) == 1
+    assert "no serving hop records" in capsys.readouterr().err
+
+
+# -- prom exposition ----------------------------------------------------------
+
+
+def test_hop_histograms_flatten_into_prom_exposition(journaled):
+    from rafiki_tpu.obs import prom
+
+    hops.absorb("q-prom", {"w0": _chain(7, *FULL)})
+    text = prom.to_prometheus(telemetry.snapshot())
+    assert 'rafiki_serving_hop_forward_s{quantile="0.99"}' in text
+    assert "rafiki_serving_hop_forward_s_count 1" in text
+    assert "rafiki_serving_hop_admission_wait_s_count 1" in text

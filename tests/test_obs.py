@@ -244,10 +244,14 @@ def test_obs_cli_trace_and_tail(journaled, capsys):
 _SNAPSHOT = {
     "ts": 1700000000.0,
     "counters": {"gateway.shed": 3, "bus.queries_added": 12.0},
-    "gauges": {"bus.queue_depth": 2},
+    "gauges": {"bus.queue_depth": 2, "serving.qps": 18.0},
     "histograms": {
         "predictor.gather_s": {"count": 4, "sum": 0.5, "p50": 0.1,
                                "p90": 0.2, "p99": 0.25},
+        "serving.hop.forward_s": {"count": 9, "sum": 0.09, "p50": 0.01,
+                                  "p90": 0.012, "p99": 0.02},
+        "serving.fanout_cost_s": {"count": 4, "sum": 0.02, "p50": 0.004,
+                                  "p90": 0.006, "p99": 0.008},
     },
     "spans": {
         'trial "quoted"': {"count": 2, "total_s": 1.5},
@@ -276,6 +280,20 @@ _SNAPSHOT = {
         "evictions": 0,
         "contained": 1,
         "badput_charged_s": 2.25,
+    },
+    "serving": {
+        "buckets_flushed": 3,
+        "last": {"bucket": 1700000000, "requests": 18, "qps": 18.0,
+                 "p50_ms": 11.5, "p99_ms": 40.25, "shed_rate": 0.0,
+                 "context_note": "strings are dropped"},
+    },
+    "serving_exemplars": {
+        "retained": 2,
+        "offered": 18,
+        "windows_flushed": 1,
+        "cap": 8,
+        "window_s": 30.0,
+        "slowest_s": 0.040251,
     },
 }
 
