@@ -1,0 +1,98 @@
+"""RF010 nondeterministic-sim.
+
+Digital-twin finding (PR 11, docs/twin.md): the twin's contract is
+that one seed reproduces a simulation bit-for-bit — the validation
+gate, the chaos pre-gate and the fleet search all hash event logs and
+diff reruns, so ONE ambient-entropy read anywhere in
+``rafiki_tpu/obs/twin/`` silently voids every downstream guarantee.
+The failure is nasty precisely because it's invisible: the sim still
+runs, the numbers still look plausible, and the nondeterminism only
+surfaces as an unreproducible validation flake weeks later.
+
+Flagged inside the twin package only:
+
+* ``random.Random()`` with no arguments — OS-entropy seeding;
+* module-level ``random.<fn>()`` calls (``random.random()``,
+  ``random.randrange(...)``, …) — the shared global RNG, whose state
+  any other import can perturb;
+* clock reads: ``time.time/monotonic/perf_counter/…``,
+  ``datetime.datetime.now/utcnow``, ``datetime.date.today`` — wall or
+  process time leaking into simulated time.
+
+Method calls on an explicitly seeded instance (``self.rng.random()``,
+``rng.randrange(n)``) are the sanctioned pattern and are not flagged.
+Legitimate ambient reads — e.g. a wall timestamp stamped onto an
+artifact as metadata, never fed back into the simulation — justify-
+suppress, stating what keeps the value out of the sim state.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+from rafiki_tpu.analysis.checkers._ast_util import dotted_name
+
+#: The package whose determinism contract this checker enforces.
+SCOPE = "rafiki_tpu.obs.twin"
+
+#: Ambient clock reads (dotted call names).
+CLOCK_CALLS = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time", "time.time_ns", "time.monotonic_ns",
+    "time.perf_counter_ns", "datetime.datetime.now",
+    "datetime.datetime.utcnow", "datetime.date.today",
+    "datetime.now", "datetime.utcnow", "date.today",
+})
+
+#: Module-level functions of the global `random` RNG. Any
+#: ``random.<fn>(...)`` call is shared-state; the seeded-instance
+#: methods (``rng.<fn>()``) don't match because their dotted name
+#: starts with the instance variable, not the module.
+GLOBAL_RANDOM_PREFIX = "random."
+
+
+@register
+class NondeterministicSim(Checker):
+    id = "RF010"
+    name = "nondeterministic-sim"
+    severity = "error"
+    rationale = ("the twin's replay/validation guarantees hash event "
+                 "logs across reruns: unseeded RNG or ambient clock "
+                 "reads inside rafiki_tpu/obs/twin/ void determinism "
+                 "invisibly — thread a random.Random(seed) through, or "
+                 "justify-suppress metadata-only wall stamps")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if SCOPE not in ctx.module_name:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name == "random.Random" and not node.args:
+                findings.append(self.finding(
+                    ctx, node,
+                    "`random.Random()` with no seed draws OS entropy: "
+                    "the twin's bit-identical-replay contract needs "
+                    "every stream seeded (random.Random(seed) or a "
+                    "derived f\"{seed}:stream\" key)"))
+            elif (name.startswith(GLOBAL_RANDOM_PREFIX)
+                    and name != "random.Random"
+                    and name.count(".") == 1):
+                findings.append(self.finding(
+                    ctx, node,
+                    f"`{name}(...)` uses the GLOBAL random stream — any "
+                    f"other import can perturb its state between runs; "
+                    f"call methods on an explicitly seeded "
+                    f"random.Random instance instead"))
+            elif name in CLOCK_CALLS:
+                findings.append(self.finding(
+                    ctx, node,
+                    f"`{name}()` reads an ambient clock inside the twin "
+                    f"package: simulated time must come from the event "
+                    f"heap, not the host — or justify-suppress a "
+                    f"metadata-only artifact timestamp"))
+        return findings

@@ -71,6 +71,10 @@ class ScenarioReport:
     # tempdir is gone by the time the report is read, so the payload is
     # carried, not the path). None when nothing dumped.
     flight_record: Optional[dict] = None
+    # Digital-twin pre-gate forecast (obs/twin/pregate.py): the spec's
+    # predicted serving impact, simulated offline BEFORE injection. None
+    # for specs that touch no serving site, or if forecasting failed.
+    twin_forecast: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return {
@@ -84,6 +88,7 @@ class ScenarioReport:
                                "role": self.flight_record.get("role"),
                                "pid": self.flight_record.get("pid")}
                               if self.flight_record else None),
+            "twin_forecast": self.twin_forecast,
         }
 
 
@@ -114,6 +119,7 @@ def run_scenario(name: str) -> ScenarioReport:
         checks.append(CheckResult(cname, bool(ok), str(detail)))
 
     plane = FaultPlane.from_spec(sc.spec)  # parse FIRST: typos fail loudly
+    twin_forecast = _twin_pregate(sc.spec)
     saved = _set_env(dict(sc.env, **{ENV_VAR: sc.spec}))
     install(plane)
     telemetry.reset()
@@ -162,7 +168,21 @@ def run_scenario(name: str) -> ScenarioReport:
     passed = error is None and bool(checks) and all(c.ok for c in checks)
     return ScenarioReport(name=name, passed=passed, checks=checks,
                           schedule=plane.schedule(), duration_s=duration,
-                          error=error, flight_record=flight)
+                          error=error, flight_record=flight,
+                          twin_forecast=twin_forecast)
+
+
+def _twin_pregate(spec: str) -> Optional[dict]:
+    """Ask the digital twin what this spec should do to serving before
+    injecting it for real (docs/twin.md). Advisory only: any failure
+    degrades to None — the pre-gate must never break the scenario it
+    pre-games. Runs before install()/telemetry.reset so the forecast's
+    simulated chaos decisions can't pollute the scenario's counters."""
+    try:
+        from rafiki_tpu.obs.twin import pregate
+        return pregate.forecast(spec)
+    except Exception:
+        return None
 
 
 def _journal_checks(check, log_dir: Path, flights: List[Path]) -> None:

@@ -6,10 +6,14 @@ policies, and graceful drain. See docs/serving.md.
 
 from rafiki_tpu.gateway.admission import AdmissionController, ShedError
 from rafiki_tpu.gateway.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
-from rafiki_tpu.gateway.gateway import POLICIES, Gateway, GatewayConfig
+from rafiki_tpu.gateway.gateway import (DEADLINE_RESERVE_FRAC,
+                                        LATENCY_EWMA_ALPHA, POLICIES,
+                                        RETRY_AFTER_FLOOR_S, Gateway,
+                                        GatewayConfig)
 
 __all__ = [
     "AdmissionController", "ShedError",
     "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
     "Gateway", "GatewayConfig", "POLICIES",
+    "DEADLINE_RESERVE_FRAC", "LATENCY_EWMA_ALPHA", "RETRY_AFTER_FLOOR_S",
 ]

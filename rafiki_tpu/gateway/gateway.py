@@ -30,7 +30,6 @@ collector so breaker state shows up in every snapshot.
 from __future__ import annotations
 
 import dataclasses
-import math
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -43,8 +42,21 @@ from rafiki_tpu.obs import context as trace_context
 from rafiki_tpu.obs.anatomy import hops as _hops
 from rafiki_tpu.obs.anatomy.timeseries import ServingRollup
 from rafiki_tpu.obs.journal import journal as _journal
+from rafiki_tpu.predictor.predictor import default_quorum
 
 POLICIES = ("replicate-all", "least-loaded")
+
+# Queueing constants the digital twin (rafiki_tpu/obs/twin/) mirrors.
+# Exported module-level — NOT inlined below — so the simulator imports
+# the live values and a tuning change here moves the twin's admission
+# model in the same commit (docs/twin.md).
+#: Fraction of a request's deadline the admission queue may consume
+#: before the expected service time no longer fits (shed-early rule).
+DEADLINE_RESERVE_FRAC = 0.5
+#: Smoothing weight of the newest sample in the gateway latency EWMA.
+LATENCY_EWMA_ALPHA = 0.2
+#: Minimum Retry-After hint — clients must never busy-spin.
+RETRY_AFTER_FLOOR_S = 0.1
 
 
 @dataclasses.dataclass
@@ -114,6 +126,19 @@ class Gateway:
         # ever assert on the live one.
         telemetry.register_collector("gateway", self.stats)
         telemetry.register_collector("serving", self.rollup.collector)
+        # Durable knob record: the digital twin's calibration extractor
+        # (scripts/twin_calibrate.py) reads the LIVE limits out of the
+        # journals instead of guessing defaults — a journal dir is a
+        # complete capacity-model input on its own (docs/twin.md).
+        _journal.record("gateway", "config",
+                        max_inflight=self.cfg.max_inflight,
+                        max_queue=self.cfg.max_queue,
+                        default_deadline_s=self.cfg.default_deadline_s,
+                        min_replies=self.cfg.min_replies,
+                        hedge_grace_s=self.cfg.hedge_grace_s,
+                        policy=self.cfg.policy,
+                        breaker_failures=self.cfg.breaker_failures,
+                        breaker_cooldown_s=self.cfg.breaker_cooldown_s)
 
     # -- the predict path ----------------------------------------------------
 
@@ -157,7 +182,8 @@ class Gateway:
         # Deadline-aware admission: don't hold a waiter past the point
         # where the expected service time no longer fits its deadline —
         # shedding NOW beats admitting a request doomed to time out.
-        reserve = min(self._expected_service_s(), deadline_s * 0.5)
+        reserve = min(self._expected_service_s(),
+                      deadline_s * DEADLINE_RESERVE_FRAC)
         try:
             waited = self.admission.admit(deadline - reserve,
                                           retry_after_s=self._retry_after())
@@ -232,7 +258,7 @@ class Gateway:
                 allowed = allowed[:1]
             return allowed, 1
         quorum = (self.cfg.min_replies if self.cfg.min_replies is not None
-                  else max(1, math.ceil(len(allowed) / 2)))
+                  else default_quorum(len(allowed)))
         return allowed, quorum
 
     def _breaker(self, worker_id: str) -> CircuitBreaker:
@@ -265,8 +291,9 @@ class Gateway:
             self._timeouts += report.timeouts
             if report.timeouts == 0 and n_queries:
                 prev = self._latency_ewma_s
+                a = LATENCY_EWMA_ALPHA
                 self._latency_ewma_s = (elapsed_s if prev is None
-                                        else 0.8 * prev + 0.2 * elapsed_s)
+                                        else (1 - a) * prev + a * elapsed_s)
         if report.hedged:
             telemetry.inc("gateway.hedged", report.hedged)
 
@@ -282,7 +309,8 @@ class Gateway:
         with self._lock:
             ewma = self._latency_ewma_s or 0.1
         backlog = self.admission.waiting + 1
-        return round(max(0.1, ewma * backlog / self.cfg.max_inflight), 3)
+        return round(max(RETRY_AFTER_FLOOR_S,
+                         ewma * backlog / self.cfg.max_inflight), 3)
 
     def _rollup_context(self) -> Dict[str, Any]:
         """Live context merged into each serving/ts row: queue depth,

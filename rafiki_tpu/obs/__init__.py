@@ -29,7 +29,7 @@ import importlib
 
 from rafiki_tpu.obs import context, journal  # noqa: F401  (eager, dep-free)
 
-_LAZY = ("anatomy", "ledger", "perf", "prom", "recorder", "cli")
+_LAZY = ("anatomy", "ledger", "perf", "prom", "recorder", "twin", "cli")
 
 __all__ = ["context", "journal", *_LAZY, "configure_from_env"]
 

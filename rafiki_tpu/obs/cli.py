@@ -599,6 +599,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp = sub.add_parser("serving",
                         help="continuous serving time-series rows")
     sp.add_argument("-n", type=int, default=32)
+    from rafiki_tpu.obs.twin import cli as twin_cli
+
+    # Stdlib-only at import time; the engine loads inside the verbs.
+    twin_cli.attach(sub)
     args = p.parse_args(argv)
 
     if args.cmd == "replay":
@@ -623,4 +627,6 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_tails(log_dir, args.json, args.check, args.tolerance)
     if args.cmd == "serving":
         return cmd_serving(log_dir, args.n, args.json)
+    if args.cmd == "twin":
+        return twin_cli.dispatch(args, log_dir, args.json)
     return cmd_slowest(log_dir, args.n, args.json)

@@ -8,7 +8,9 @@ math are importable without any server.
 """
 
 from rafiki_tpu.predictor.ensemble import ensemble_predictions
-from rafiki_tpu.predictor.predictor import GatherReport, Predictor, default_quorum
+from rafiki_tpu.predictor.predictor import (DEFAULT_HEDGE_GRACE_S,
+                                            GatherReport, Predictor,
+                                            default_quorum)
 
-__all__ = ["GatherReport", "Predictor", "default_quorum",
-           "ensemble_predictions"]
+__all__ = ["DEFAULT_HEDGE_GRACE_S", "GatherReport", "Predictor",
+           "default_quorum", "ensemble_predictions"]

@@ -38,6 +38,12 @@ from rafiki_tpu.obs.anatomy import hops as _hops
 from rafiki_tpu.obs.journal import journal as _journal
 from rafiki_tpu.predictor.ensemble import ensemble_predictions
 
+#: Straggler grace once the gather quorum arrived. Exported (not an
+#: inline default) because the digital twin (rafiki_tpu/obs/twin/)
+#: mirrors the quorum-gather semantics — twin and live code must read
+#: the SAME constant or capacity predictions silently drift.
+DEFAULT_HEDGE_GRACE_S = 0.25
+
 
 @dataclasses.dataclass
 class GatherReport:
@@ -70,7 +76,7 @@ class Predictor:
     def __init__(self, bus, job_id: str, timeout_s: float = 10.0,
                  worker_ttl_s: float = 3.0,
                  min_replies: Optional[int] = None,
-                 hedge_grace_s: float = 0.25):
+                 hedge_grace_s: float = DEFAULT_HEDGE_GRACE_S):
         self.bus = bus
         self.job_id = job_id
         self.timeout_s = timeout_s

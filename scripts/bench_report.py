@@ -59,11 +59,22 @@ SERVING_METRICS = {
     "ensemble_fanout_cost_ms": "lower",
 }
 
+#: Twin-validation rounds (``--twin``): TWIN_r*.json artifacts from
+#: ``python -m rafiki_tpu.obs twin validate --out`` (docs/twin.md).
+#: Both errors are relative |predicted-measured|/measured — lower is a
+#: better-calibrated twin; a creeping error trend means the simulator
+#: has drifted from the serving code it predicts.
+TWIN_METRICS = {
+    "p50_err": "lower",
+    "p99_err": "lower",
+}
+
 #: Metrics where 0 is a legitimate measurement, not "did not run" —
-#: a clean serving round genuinely sheds nothing and a 1-worker round
-#: has zero fan-out cost. (Throughput-style metrics keep the strict
+#: a clean serving round genuinely sheds nothing, a 1-worker round
+#: has zero fan-out cost, and a perfectly calibrated twin has zero
+#: prediction error. (Throughput-style metrics keep the strict
 #: v > 0 rule: their zeros mean a dead backend.)
-ZERO_OK = {"shed_rate", "ensemble_fanout_cost_ms"}
+ZERO_OK = {"shed_rate", "ensemble_fanout_cost_ms", "p50_err", "p99_err"}
 
 
 def _payload_from_tail(tail: Any) -> Optional[Dict[str, Any]]:
@@ -101,8 +112,8 @@ def load_round(path: str) -> Dict[str, Any]:
     if not isinstance(doc, dict):
         out["error"] = "artifact is not a JSON object"
         return out
-    if ("metric" in doc or "headline" in doc
-            or "qps" in doc or "schema_version" in doc):
+    if ("metric" in doc or "headline" in doc or "qps" in doc
+            or "schema_version" in doc or "twin_schema_version" in doc):
         # A raw bench.py / bench_serving.py result saved directly, no
         # driver wrapper.
         out["payload"], out["source"] = doc, "raw"
@@ -142,6 +153,17 @@ def serving_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     if not isinstance(payload, dict) or payload.get("error"):
         return {}
     return {k: payload.get(k) for k in SERVING_METRICS
+            if payload.get(k) is not None}
+
+
+def twin_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """The twin-error block: validate artifacts carry p50_err/p99_err
+    at top level. Error rounds (journals missing, too few requests)
+    yield nothing — a round that never validated is no-data, not a
+    perfect score."""
+    if not isinstance(payload, dict) or payload.get("error"):
+        return {}
+    return {k: payload.get(k) for k in TWIN_METRICS
             if payload.get(k) is not None}
 
 
@@ -220,11 +242,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--serving", action="store_true",
                    help="trend bench_serving.py rounds (SERVING_r*.json "
                         "default glob, qps/p50/p99/shed/fanout polarities)")
+    p.add_argument("--twin", action="store_true",
+                   help="trend twin-validation rounds (TWIN_r*.json "
+                        "default glob, p50_err/p99_err lower-better)")
     args = p.parse_args(argv)
 
-    metric_set = SERVING_METRICS if args.serving else METRICS
-    headline_fn = serving_headline_of if args.serving else headline_of
-    pattern = "SERVING_r*.json" if args.serving else "BENCH_r*.json"
+    if args.serving and args.twin:
+        print(json.dumps({"error": "--serving and --twin are exclusive"}))
+        return 2
+    if args.twin:
+        metric_set, headline_fn = TWIN_METRICS, twin_headline_of
+        pattern = "TWIN_r*.json"
+    elif args.serving:
+        metric_set, headline_fn = SERVING_METRICS, serving_headline_of
+        pattern = "SERVING_r*.json"
+    else:
+        metric_set, headline_fn = METRICS, headline_of
+        pattern = "BENCH_r*.json"
 
     paths = args.artifacts
     if not paths:
@@ -245,7 +279,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         "schema_version": REPORT_SCHEMA_VERSION,
         "tolerance": args.tolerance,
         "n_rounds": len(rounds),
-        "mode": "serving" if args.serving else "training",
+        "mode": ("twin" if args.twin
+                 else "serving" if args.serving else "training"),
         "rounds": [{"round": r["round"], "rc": r["rc"],
                     "source": r["source"],
                     "has_data": bool(headline_fn(r["payload"]))}

@@ -59,6 +59,12 @@ env JAX_PLATFORMS=cpu python scripts/health_smoke.py > /tmp/_health_smoke.json \
 # (docs/serving_anatomy.md).
 env JAX_PLATFORMS=cpu python scripts/serving_obs_smoke.py > /tmp/_serving_obs_smoke.json \
   || { echo "TIER1 SERVING OBS SMOKE FAILED (see /tmp/_serving_obs_smoke.json)"; exit 1; }
+# Digital-twin smoke: calibrate from a fresh captured run, validate
+# predicted-vs-measured latency BOTH ways (correct calibration passes,
+# a halved forward time fails), sweep deterministically from one seed,
+# and gate the TWIN_r* error trend both ways (docs/twin.md). ~15s.
+env JAX_PLATFORMS=cpu python scripts/twin_smoke.py > /tmp/_twin_smoke.json \
+  || { echo "TIER1 TWIN SMOKE FAILED (see /tmp/_twin_smoke.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

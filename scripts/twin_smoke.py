@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Digital-twin CI smoke: calibrate, validate both polarities, sweep
+deterministically, gate the TWIN_r* trend both ways (docs/twin.md).
+
+Five phases, real subprocesses throughout:
+
+  1. **Capture** — ``bench_serving --smoke --service-ms 20`` with a
+     fresh ``RAFIKI_LOG_DIR``. The 20ms forward dominates the ~ms
+     wiring overheads, so the mis-calibration polarity below produces
+     a ~50% latency error instead of drowning in noise.
+  2. **Calibrate, both polarities** — ``twin_calibrate`` must write a
+     versioned bundle from the captured journals (exit 0), and must
+     exit 2 on an empty dir, naming BOTH missing record kinds
+     (serving/hops, gateway/config) in one message.
+  3. **Validate, both polarities** — ``obs twin validate`` replaying
+     the captured run must land predicted-vs-measured p50/p99 inside
+     tolerance (exit 0); with ``--scale forward=0.5`` the same gate
+     must FAIL (exit 1) — a twin that cannot detect a halved forward
+     time validates nothing.
+  4. **Deterministic sweep** — ``obs twin sweep`` over a worker grid,
+     run twice with one seed, must emit byte-identical JSON, and each
+     row must name its first-saturating resource.
+  5. **Report gate, both polarities** — ``bench_report --twin`` over
+     synthetic TWIN_r*.json rounds: an improving error trend exits 0,
+     a regressed round (calibration drift) exits 1, and an
+     error-payload round reads as no-data, not a perfect score.
+
+Output: one JSON object on stdout. Exit 0 when every assertion holds;
+1 otherwise — this is a CI gate (scripts/check_tier1.sh).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SEED = "7"
+
+
+def _run(cmd, env=None, timeout=300):
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          env=full_env, timeout=timeout, cwd=REPO)
+
+
+def _twin(log_dir, *verb_args):
+    return _run([sys.executable, "-m", "rafiki_tpu.obs", "--dir", log_dir,
+                 "--json", "twin", *verb_args])
+
+
+def phase_capture(results):
+    log_dir = tempfile.mkdtemp(prefix="twin_smoke_")
+    r = _run([sys.executable, "scripts/bench_serving.py", "--smoke",
+              "--service-ms", "20"], env={"RAFIKI_LOG_DIR": log_dir})
+    try:
+        report = json.loads(r.stdout)
+    except ValueError:
+        report = {"unparseable_stdout": r.stdout[-400:]}
+    ph = {"bench_rc": r.returncode,
+          "qps": report.get("qps"), "p50_ms": report.get("p50_ms"),
+          "ok": r.returncode == 0 and bool(report.get("qps"))}
+    if not ph["ok"]:
+        ph["bench_stderr"] = r.stderr[-400:]
+    results["capture"] = ph
+    return log_dir if ph["ok"] else None
+
+
+def phase_calibrate(results, log_dir):
+    bundle = os.path.join(tempfile.mkdtemp(prefix="twin_cal_"),
+                          "twin_cal.json")
+    pos = _run([sys.executable, "scripts/twin_calibrate.py", log_dir,
+                "-o", bundle, "--json"])
+    empty = tempfile.mkdtemp(prefix="twin_cal_empty_")
+    neg = _run([sys.executable, "scripts/twin_calibrate.py", empty,
+                "-o", os.path.join(empty, "x.json"), "--json"])
+    try:
+        neg_doc = json.loads(neg.stdout)
+    except ValueError:
+        neg_doc = {}
+    missing = neg_doc.get("missing") or []
+    ph = {
+        "calibrate_rc": pos.returncode,
+        "bundle_written": os.path.exists(bundle),
+        "empty_dir_rc": neg.returncode,
+        "empty_dir_missing": missing,
+        "ok": (pos.returncode == 0 and os.path.exists(bundle)
+               and neg.returncode == 2
+               and set(missing) == {"serving/hops", "gateway/config"}),
+    }
+    if not ph["ok"]:
+        ph["calibrate_stderr"] = pos.stderr[-300:]
+        ph["empty_stderr"] = neg.stderr[-300:]
+    results["calibrate"] = ph
+    return bundle if ph["ok"] else None
+
+
+def phase_validate(results, log_dir, bundle):
+    good = _twin(log_dir, "validate", "--seed", SEED)
+    bad = _twin(log_dir, "validate", "--seed", SEED,
+                "--scale", "forward=0.5")
+    try:
+        good_doc = json.loads(good.stdout)
+    except ValueError:
+        good_doc = {}
+    try:
+        bad_doc = json.loads(bad.stdout)
+    except ValueError:
+        bad_doc = {}
+    ph = {
+        "good_rc": good.returncode,
+        "good_p50_err": good_doc.get("p50_err"),
+        "good_p99_err": good_doc.get("p99_err"),
+        "tolerance": good_doc.get("tolerance"),
+        "miscal_rc": bad.returncode,
+        "miscal_p50_err": bad_doc.get("p50_err"),
+        "ok": (good.returncode == 0 and good_doc.get("ok") is True
+               and bad.returncode == 1 and bad_doc.get("ok") is False),
+    }
+    if not ph["ok"]:
+        ph["good_stderr"] = good.stderr[-300:]
+        ph["miscal_stderr"] = bad.stderr[-300:]
+    results["validate"] = ph
+    return good_doc if ph["ok"] else None
+
+
+def phase_sweep(results, log_dir):
+    args = ("sweep", "--seed", SEED, "--qps", "60", "--duration", "4",
+            "--grid", "workers=1,2,4", "--fleet")
+    a = _twin(log_dir, *args)
+    b = _twin(log_dir, *args)
+    try:
+        doc = json.loads(a.stdout)
+    except ValueError:
+        doc = {}
+    rows = doc.get("rows") or []
+    ph = {
+        "rc": a.returncode,
+        "rows": len(rows),
+        "deterministic": a.stdout == b.stdout and a.returncode == 0,
+        "saturating_named": bool(rows) and all(
+            r.get("first_saturating") for r in rows),
+        "fleet_workers": (doc.get("fleet") or {}).get("workers"),
+        "ok": False,
+    }
+    ph["ok"] = (ph["rc"] == 0 and ph["rows"] == 3 and ph["deterministic"]
+                and ph["saturating_named"]
+                and ph["fleet_workers"] is not None)
+    if not ph["ok"]:
+        ph["stderr"] = a.stderr[-300:]
+    results["sweep"] = ph
+    return ph["ok"]
+
+
+def phase_report_gate(results, good_doc):
+    """bench_report --twin over synthetic rounds, both polarities.
+    Round artifacts reuse the real validate doc with doctored errors
+    so the trend exercises the actual artifact schema."""
+    td = tempfile.mkdtemp(prefix="twin_rounds_")
+
+    def _round(n, doc):
+        path = os.path.join(td, f"TWIN_r{n:02d}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+    base = dict(good_doc)
+    improving = [
+        _round(1, dict(base, p50_err=0.30, p99_err=0.35)),
+        _round(2, dict(base, p50_err=0.12, p99_err=0.15)),
+        _round(3, {"error": "no journals captured this round"}),
+        _round(4, dict(base, p50_err=0.10, p99_err=0.12)),
+    ]
+    ok_run = _run([sys.executable, "scripts/bench_report.py", "--twin",
+                   *improving])
+    regressed = improving + [
+        _round(5, dict(base, p50_err=0.55, p99_err=0.60))]
+    bad_run = _run([sys.executable, "scripts/bench_report.py", "--twin",
+                    *regressed])
+    try:
+        ok_doc = json.loads(ok_run.stdout)
+        bad_doc = json.loads(bad_run.stdout)
+    except ValueError:
+        ok_doc, bad_doc = {}, {}
+    error_round_has_data = any(
+        r.get("has_data") for r in ok_doc.get("rounds", [])
+        if str(r.get("round", "")).endswith("r03.json"))
+    ph = {
+        "ok_rc": ok_run.returncode,
+        "ok_verdict": ok_doc.get("verdict"),
+        "regressed_rc": bad_run.returncode,
+        "regressed_metrics": bad_doc.get("regressed"),
+        "error_round_counted": error_round_has_data,
+        "ok": (ok_run.returncode == 0 and ok_doc.get("verdict") == "ok"
+               and bad_run.returncode == 1
+               and "p50_err" in (bad_doc.get("regressed") or [])
+               and not error_round_has_data),
+    }
+    if not ph["ok"]:
+        ph["ok_stderr"] = ok_run.stderr[-300:]
+        ph["regressed_stderr"] = bad_run.stderr[-300:]
+    results["report_gate"] = ph
+    return ph["ok"]
+
+
+def main() -> int:
+    results = {}
+    log_dir = phase_capture(results)
+    ok = log_dir is not None
+    bundle = good_doc = None
+    if ok:
+        bundle = phase_calibrate(results, log_dir)
+        ok = bundle is not None
+    if ok:
+        good_doc = phase_validate(results, log_dir, bundle)
+        ok = good_doc is not None
+    if ok:
+        ok = phase_sweep(results, log_dir) and ok
+    if ok and good_doc:
+        ok = phase_report_gate(results, good_doc) and ok
+    results["ok"] = ok
+    print(json.dumps(results))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

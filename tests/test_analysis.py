@@ -29,7 +29,7 @@ def _analyze_snippet(tmp_path, source, name="snippet.py", select=None):
 
 def test_all_builtin_checkers_registered():
     assert {"RF001", "RF002", "RF003", "RF004", "RF005",
-            "RF006", "RF007", "RF008", "RF009"} <= set(REGISTRY)
+            "RF006", "RF007", "RF008", "RF009", "RF010"} <= set(REGISTRY)
 
 
 # ---------------------------------------------------------------------------
@@ -664,6 +664,84 @@ def test_rf009_current_tree_is_clean():
                        os.path.join(REPO, "bench.py"),
                        os.path.join(REPO, "scripts")], select=["RF009"])
     mine = [f for f in r.unsuppressed if f.checker_id == "RF009"]
+    assert mine == [], [f"{f.path}:{f.line}" for f in mine]
+
+
+# ---------------------------------------------------------------------------
+# RF010 nondeterministic-sim
+# ---------------------------------------------------------------------------
+
+
+def _twin_snippet(tmp_path, source, select=None):
+    """Write the snippet INSIDE a rafiki_tpu/obs/twin/ package tree so
+    module_name_for resolves it into RF010's scope."""
+    twin = tmp_path / "rafiki_tpu" / "obs" / "twin"
+    twin.mkdir(parents=True)
+    for d in (tmp_path / "rafiki_tpu", tmp_path / "rafiki_tpu" / "obs",
+              twin):
+        (d / "__init__.py").write_text("")
+    f = twin / "snippet.py"
+    f.write_text(textwrap.dedent(source))
+    return analyze_paths([str(f)], select=select)
+
+
+RF010_BAD = """
+    import random
+    import time
+
+    def simulate_badly(n):
+        rng = random.Random()            # OS entropy
+        jitter = random.random()         # global stream
+        t0 = time.monotonic()            # ambient clock
+        return rng, jitter, t0
+    """
+
+
+def test_rf010_fires_on_each_entropy_source(tmp_path):
+    r = _twin_snippet(tmp_path, RF010_BAD)
+    found = [f for f in r.unsuppressed if f.checker_id == "RF010"]
+    assert len(found) == 3
+    messages = " ".join(f.message for f in found)
+    assert "OS entropy" in messages
+    assert "GLOBAL random stream" in messages
+    assert "ambient clock" in messages
+
+
+def test_rf010_scoped_to_twin_package_only(tmp_path):
+    # The identical source OUTSIDE rafiki_tpu/obs/twin/ is legal:
+    # entropy is only a defect where determinism is the contract.
+    r = _analyze_snippet(tmp_path, RF010_BAD)
+    assert "RF010" not in _ids(r)
+
+
+def test_rf010_quiet_on_seeded_streams(tmp_path):
+    r = _twin_snippet(tmp_path, """
+        import random
+
+        def simulate(seed, samples):
+            rng = random.Random(f"{seed}:service")
+            return samples[rng.randrange(len(samples))] + rng.random()
+        """)
+    assert "RF010" not in _ids(r)
+
+
+def test_rf010_justified_suppression_honored(tmp_path):
+    r = _twin_snippet(tmp_path, """
+        import time
+
+        def artifact(doc):
+            # lint: disable=RF010 — metadata stamp, not simulation state
+            doc["created_ts"] = time.time()
+            return doc
+        """)
+    assert "RF010" not in _ids(r)
+
+
+def test_rf010_current_tree_is_clean():
+    r = analyze_paths([os.path.join(REPO, "rafiki_tpu"),
+                       os.path.join(REPO, "bench.py"),
+                       os.path.join(REPO, "scripts")], select=["RF010"])
+    mine = [f for f in r.unsuppressed if f.checker_id == "RF010"]
     assert mine == [], [f"{f.path}:{f.line}" for f in mine]
 
 
