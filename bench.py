@@ -663,11 +663,20 @@ def run_advisor_lift(sc: dict, detail: dict) -> None:
     # >=3 seeds with dispersion (r4 directive 8): a one-seed lift at
     # smoke scale is within noise; the claim must carry its spread.
     lifts, best_lifts = [], []
+    diffs, gp_scores = [], []
+    t0 = time.monotonic()
     for s in range(sc["lift_seeds"]):
         s_gp = sweep(GpAdvisor(kc, seed=s, n_initial=warmup))
         s_rnd = sweep(RandomAdvisor(kc, seed=100 + s))
         lifts.append(round(mean(s_gp[warmup:]) - mean(s_rnd[warmup:]), 4))
         best_lifts.append(round(max(s_gp) - max(s_rnd), 4))
+        # position-paired post-warmup diffs, pooled across seeds: the
+        # bootstrap resamples these, so the CI reflects both seed and
+        # position noise (docs/search_anatomy.md).
+        diffs.extend(g - r for g, r in zip(s_gp[warmup:], s_rnd[warmup:]))
+        gp_scores.extend(s_gp)
+    # lint: disable=RF007 — sweep A/B wall, reported in detail.search
+    sweep_wall_s = time.monotonic() - t0
     m_lift = mean(lifts)
     spread = max(abs(l - m_lift) for l in lifts)
     detail["advisor_lift"] = round(m_lift, 4)
@@ -677,6 +686,30 @@ def run_advisor_lift(sc: dict, detail: dict) -> None:
     detail["advisor_lift_significant"] = (m_lift - spread) > 0
     detail["advisor_lift_best"] = round(mean(best_lifts), 4)
     detail["advisor_lift_trials"] = n * sc["lift_seeds"]
+    # Search-anatomy block: the same lift claim with a bootstrap CI
+    # (fixed seed — byte-reproducible across runs on the same scores),
+    # plus the probe sweep's regret curve and effective throughput so
+    # bench_report --sweep can trend them from SWEEP_r*.json siblings.
+    from rafiki_tpu.obs.search import stats as search_stats
+
+    ci = search_stats.bootstrap_ci(diffs, seed=0)
+    curve = search_stats.regret_curve(gp_scores)
+    n_scored = 2 * n * sc["lift_seeds"]
+    detail["search"] = {
+        "advisor_lift": round(ci["mean"], 4),
+        "lift_ci_low": round(ci["lo"], 4),
+        "lift_ci_high": round(ci["hi"], 4),
+        "lift_significant": ci["lo"] > 0,
+        "n_diffs": ci["n"],
+        "n_boot": ci["n_boot"],
+        "boot_seed": ci["seed"],
+        "best_score": curve["best_score"],
+        "regret": curve["mean_regret"],
+        "n_scored": n_scored,
+        "sweep_wall_s": round(sweep_wall_s, 3),
+        "effective_trials_per_hour": round(
+            n_scored / sweep_wall_s * 3600.0, 2) if sweep_wall_s else 0.0,
+    }
 
 
 # -- microbench: step throughput, MFU, advisor, dump ------------------------

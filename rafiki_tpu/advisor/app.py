@@ -31,6 +31,8 @@ class AdvisorApp:
             Rule("/healthz", endpoint="healthz", methods=["GET"]),
             Rule("/advisors/<advisor_id>/propose", endpoint="propose",
                  methods=["GET"]),
+            Rule("/advisors/<advisor_id>/propose_batch",
+                 endpoint="propose_batch", methods=["POST"]),
             Rule("/advisors/<advisor_id>/feedback", endpoint="feedback",
                  methods=["POST"]),
         ])
@@ -67,6 +69,24 @@ class AdvisorApp:
 
     def ep_propose(self, request: Request, advisor_id: str) -> Response:
         return self._json({"knobs": self.service.propose(advisor_id)})
+
+    def ep_propose_batch(self, request: Request,
+                         advisor_id: str) -> Response:
+        """q-batch drafting for remote sweeps. Unlike the in-proc path
+        (which clamps), a remote caller asking for n<1 is a protocol
+        error — 400, not a silent 1. The advisor engine journals the
+        advisor/propose_batch record exactly as in-proc."""
+        from werkzeug.exceptions import BadRequest
+
+        body = request.get_json(force=True, silent=True) or {}
+        try:
+            n = int(body.get("n"))
+        except (TypeError, ValueError):
+            raise BadRequest("propose_batch requires an integer 'n'")
+        if n < 1:
+            raise BadRequest(f"propose_batch n must be >= 1, got {n}")
+        return self._json(
+            {"knobs_list": self.service.propose_batch(advisor_id, n)})
 
     def ep_feedback(self, request: Request, advisor_id: str) -> Response:
         body = request.get_json(force=True)
@@ -114,6 +134,12 @@ class HttpAdvisorHandle:
 
     def propose(self):
         return self._call("GET", f"/advisors/{self._id}/propose")["knobs"]
+
+    def propose_batch(self, n: int):
+        """q proposals in one round-trip (the server clamps nothing:
+        n < 1 is a 400 — surface the caller's bug, don't paper it)."""
+        return self._call("POST", f"/advisors/{self._id}/propose_batch",
+                          json={"n": int(n)})["knobs_list"]
 
     def feedback(self, score: float, knobs) -> None:
         self._call("POST", f"/advisors/{self._id}/feedback",

@@ -27,6 +27,7 @@ from rafiki_tpu.model.knobs import (
     KnobConfig,
     Knobs,
 )
+from rafiki_tpu.obs.search import audit
 
 
 class KnobSpace:
@@ -110,9 +111,18 @@ class BaseAdvisor:
     #: must not suppress a region forever (oldest liars expire first).
     PENDING_CAP = 16
 
+    #: short engine tag stamped onto every advisor/* journal record
+    #: (docs/search_anatomy.md); subclasses override.
+    engine = "base"
+
     def __init__(self, knob_config: KnobConfig, seed: int = 0):
         self.space = KnobSpace(knob_config)
         self.knob_config = dict(knob_config)
+        self.seed = int(seed)
+        # Stamped by AdvisorService / the mesh scheduler so journal
+        # records are filterable per sweep; None for bare advisors.
+        self.advisor_id: Optional[str] = None
+        self.job_id: Optional[str] = None
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
         self.history: List[Tuple[Knobs, float]] = []
@@ -166,15 +176,19 @@ class BaseAdvisor:
                 return None
             return max(self.history, key=lambda t: t[1])
 
-    # engine hooks (called under the lock)
+    # engine hooks (called under the lock). Every implementation must
+    # journal its decision through rafiki_tpu.obs.search.audit — the
+    # RF011 checker errors on a hook body that returns without it.
     def _propose(self) -> Knobs:
         raise NotImplementedError
 
     def _propose_batch(self, n: int) -> List[Knobs]:
-        return [self._propose() for _ in range(n)]
+        out = [self._propose() for _ in range(n)]
+        audit.record_propose_batch(self, n, out, strategy="sequential")
+        return out
 
     def _feedback(self, score: float, knobs: Knobs) -> None:
-        pass
+        audit.record_feedback(self, score, knobs)
 
 
 def make_advisor(knob_config: KnobConfig, kind: str = "gp", seed: int = 0) -> BaseAdvisor:

@@ -15,6 +15,7 @@ are quasi-random exploration; after that, EI argmax.
 from __future__ import annotations
 
 import math
+import time
 import warnings
 from typing import List, Optional
 
@@ -22,9 +23,12 @@ import numpy as np
 
 from rafiki_tpu.advisor.base import BaseAdvisor
 from rafiki_tpu.model.knobs import KnobConfig, Knobs
+from rafiki_tpu.obs.search import audit
 
 
 class GpAdvisor(BaseAdvisor):
+    engine = "gp"
+
     def __init__(self, knob_config: KnobConfig, seed: int = 0,
                  n_initial: int = 8, n_candidates: int = 512, xi: float = 0.01):
         super().__init__(knob_config, seed=seed)
@@ -34,13 +38,18 @@ class GpAdvisor(BaseAdvisor):
         self._X: List[np.ndarray] = []
         self._y: List[float] = []
         self._gp = None
+        self._last_fit_s: Optional[float] = None
 
     def _propose(self) -> Knobs:
         if self.space.d == 0:
-            return dict(self.space.fixed)
+            knobs = dict(self.space.fixed)
+            audit.record_propose(self, knobs, {"phase": "fixed"})
+            return knobs
         if len(self._X) < self.n_initial or self._gp is None:
             knobs = self.space.sample(self._rng)
             self._pending_add(self.space.encode(knobs))
+            audit.record_propose(self, knobs, {
+                "phase": "warmup", "n_initial": self.n_initial})
             return knobs
         b = self.space.bounds()
         cand = self._rng.uniform(b[:, 0], b[:, 1], size=(self.n_candidates, self.space.d))
@@ -49,19 +58,30 @@ class GpAdvisor(BaseAdvisor):
         local = best_x[None, :] + self._rng.normal(
             0.0, 0.1 * (b[:, 1] - b[:, 0]), size=(self.n_candidates // 4, self.space.d))
         cand = np.clip(np.vstack([cand, local]), b[:, 0], b[:, 1])
-        ei = self._expected_improvement(cand)
+        ei, mu, sigma = self._expected_improvement(cand)
         # Penalise candidates near pending (liar) points so concurrent
         # workers don't all get the same proposal (bookkeeping lives in
         # BaseAdvisor; only the damping shape is engine-specific).
         span = np.maximum(b[:, 1] - b[:, 0], 1e-12)
+        ei_damped = ei
         for dist in self._pending_dists(cand, span):
-            ei = ei * (1.0 - np.exp(-(dist / 0.05) ** 2))
-        x = cand[int(np.argmax(ei))]
+            ei_damped = ei_damped * (1.0 - np.exp(-(dist / 0.05) ** 2))
+        i = int(np.argmax(ei_damped))
+        x = cand[i]
         knobs = self.space.decode(x)
         # Store the *re-encoded* point: decode rounds integer/categorical
         # dims, and the feedback drain removes by encode(knobs) —
         # appending raw x would leave the pending point stuck forever.
         self._pending_add(self.space.encode(knobs))
+        audit.record_propose(self, knobs, {
+            "phase": "ei",
+            "ei": round(float(ei[i]), 9),
+            "ei_damped": round(float(ei_damped[i]), 9),
+            "mu": round(float(mu[i]), 6),
+            "sigma": round(float(sigma[i]), 6),
+            "pool": int(len(cand)),
+            "fit_s": self._last_fit_s,
+        })
         return knobs
 
     def _propose_batch(self, n: int) -> List[Knobs]:
@@ -75,7 +95,7 @@ class GpAdvisor(BaseAdvisor):
             return super()._propose_batch(n)  # still exploring randomly
         out: List[Knobs] = []
         lies = 0
-        lie = min(self._y)
+        lie = float(min(self._y))
         try:
             for _ in range(n):
                 knobs = self._propose()
@@ -89,6 +109,9 @@ class GpAdvisor(BaseAdvisor):
                 del self._X[-lies:]
                 del self._y[-lies:]
                 self._fit()
+        audit.record_propose_batch(
+            self, n, out, strategy="constant_liar_min",
+            liar={"lie": round(lie, 6), "lies_planted": len(out)})
         return out
 
     def _feedback(self, score: float, knobs: Knobs) -> None:
@@ -97,11 +120,13 @@ class GpAdvisor(BaseAdvisor):
         self._y.append(score)
         if len(self._X) >= max(2, min(self.n_initial, 4)):
             self._fit()
+        audit.record_feedback(self, score, knobs)
 
     def _fit(self) -> None:
         from sklearn.gaussian_process import GaussianProcessRegressor
         from sklearn.gaussian_process.kernels import ConstantKernel, Matern, WhiteKernel
 
+        t0 = time.monotonic()
         X = np.vstack(self._X)
         y = np.asarray(self._y)
         b = self.space.bounds()
@@ -115,12 +140,18 @@ class GpAdvisor(BaseAdvisor):
             warnings.simplefilter("ignore")
             gp.fit(X, y)
         self._gp = gp
+        # Fit wall-time rides the next propose record's acquisition
+        # block — the cost side of the O(n^3) GP refit story.
+        self._last_fit_s = round(time.monotonic() - t0, 6)
 
-    def _expected_improvement(self, cand: np.ndarray) -> np.ndarray:
+    def _expected_improvement(self, cand: np.ndarray):
+        """EI per candidate, plus the posterior mean/std it was computed
+        from (the audit record carries all three for the chosen one)."""
         mu, sigma = self._gp.predict(cand, return_std=True)
         sigma = np.maximum(sigma, 1e-9)
         best = max(self._y)
         z = (mu - best - self.xi) / sigma
         from scipy.stats import norm
 
-        return (mu - best - self.xi) * norm.cdf(z) + sigma * norm.pdf(z)
+        ei = (mu - best - self.xi) * norm.cdf(z) + sigma * norm.pdf(z)
+        return ei, mu, sigma

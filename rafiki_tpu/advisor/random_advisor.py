@@ -4,8 +4,13 @@ from __future__ import annotations
 
 from rafiki_tpu.advisor.base import BaseAdvisor
 from rafiki_tpu.model.knobs import Knobs
+from rafiki_tpu.obs.search import audit
 
 
 class RandomAdvisor(BaseAdvisor):
+    engine = "random"
+
     def _propose(self) -> Knobs:
-        return self.space.sample(self._rng)
+        knobs = self.space.sample(self._rng)
+        audit.record_propose(self, knobs, {"phase": "random"})
+        return knobs

@@ -29,7 +29,12 @@ class AdvisorService:
         aid = advisor_id or uuid.uuid4().hex
         with self._lock:
             if aid not in self._advisors:
-                self._advisors[aid] = make_advisor(knob_config, kind=kind, seed=seed)
+                adv = make_advisor(knob_config, kind=kind, seed=seed)
+                # Stamp the registry id so every advisor/* journal
+                # record this engine emits is filterable per sweep
+                # (obs sweep <job> — docs/search_anatomy.md).
+                adv.advisor_id = aid
+                self._advisors[aid] = adv
         return aid
 
     def get(self, advisor_id: str) -> BaseAdvisor:

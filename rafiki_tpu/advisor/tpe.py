@@ -23,9 +23,12 @@ import numpy as np
 
 from rafiki_tpu.advisor.base import BaseAdvisor
 from rafiki_tpu.model.knobs import KnobConfig, Knobs
+from rafiki_tpu.obs.search import audit
 
 
 class TpeAdvisor(BaseAdvisor):
+    engine = "tpe"
+
     def __init__(self, knob_config: KnobConfig, seed: int = 0,
                  n_initial: int = 8, n_candidates: int = 64,
                  gamma: float = 0.25, epsilon: float = 0.1):
@@ -65,7 +68,11 @@ class TpeAdvisor(BaseAdvisor):
 
     def _propose(self) -> Knobs:
         if self.space.d == 0:
-            return dict(self.space.fixed)
+            knobs = dict(self.space.fixed)
+            audit.record_propose(self, knobs, {"phase": "fixed"})
+            return knobs
+        # Short-circuit order matters for RNG-stream parity with the
+        # pre-audit code: the epsilon draw only happens past warmup.
         if (len(self._X) < max(2, self.n_initial)
                 or self._rng.random() < self.epsilon):
             # Warmup (>=2 observations or the good/bad split is
@@ -73,8 +80,13 @@ class TpeAdvisor(BaseAdvisor):
             # model can only believe what it has sampled, so a value
             # never proposed (e.g. a categorical choice absent from the
             # good set) would stay unproposed forever without this.
+            phase = ("warmup" if len(self._X) < max(2, self.n_initial)
+                     else "epsilon")
             knobs = self.space.sample(self._rng)
             self._pending_add(self.space.encode(knobs))
+            audit.record_propose(self, knobs, {
+                "phase": phase, "n_initial": self.n_initial,
+                "epsilon": self.epsilon})
             return knobs
 
         b = self.space.bounds()
@@ -127,15 +139,24 @@ class TpeAdvisor(BaseAdvisor):
         # (bookkeeping in BaseAdvisor; only the damping shape here).
         for dist in self._pending_dists(cand, span):
             score = score - 4.0 * np.exp(-(dist / 0.05) ** 2)
-        x = cand[int(np.argmax(score))]
+        i = int(np.argmax(score))
+        x = cand[i]
         knobs = self.space.decode(x)
         self._pending_add(self.space.encode(knobs))
+        audit.record_propose(self, knobs, {
+            "phase": "tpe",
+            "log_ratio": round(float(score[i]), 6),
+            "pool": int(n_cand),
+            "n_good": int(n_good),
+            "gamma": self.gamma,
+        })
         return knobs
 
     def _feedback(self, score: float, knobs: Knobs) -> None:
         x = self.space.encode(knobs)
         self._X.append(x)
         self._y.append(score)
+        audit.record_feedback(self, score, knobs)
 
     @staticmethod
     def _log_kde(cand: np.ndarray, pts: np.ndarray, bw: np.ndarray) -> np.ndarray:

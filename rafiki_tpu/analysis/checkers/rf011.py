@@ -1,0 +1,127 @@
+"""RF011 unjournaled-decision.
+
+Search-anatomy finding (PR 12, docs/search_anatomy.md): the advisor
+decision audit only works if EVERY engine journals its proposals and
+feedback — ``obs sweep`` reconciles feedback records against propose
+records and fails the whole sweep loudly when a decision escaped the
+trail. A new advisor whose ``_propose``/``_feedback`` hook returns
+without calling into ``rafiki_tpu.obs.search.audit`` (or the journal
+directly) doesn't just lose its own telemetry: it turns every sweep
+that uses it into a reconciliation failure, or — worse, if the hook
+also skips the ledger — silently corrupts the effective-trials-per-
+hour and regret numbers the capacity plane trends.
+
+Flagged inside ``rafiki_tpu/advisor/`` only: a decision hook — any
+function named ``_feedback`` or starting with ``_propose`` — whose
+body never calls a name imported from ``rafiki_tpu.obs.journal`` or
+``rafiki_tpu.obs.search*``. Abstract hooks (a body that only raises,
+like ``BaseAdvisor._propose``) are exempt: they decide nothing.
+Engines that inherit the base hooks are covered by the base's own
+audit calls and define nothing for this rule to inspect.
+
+Legitimate non-journaling hooks (a pure in-memory shim in tests, a
+delegating wrapper whose inner engine journals) justify-suppress,
+stating which layer carries the record.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+from rafiki_tpu.analysis.checkers._ast_util import dotted_name
+
+#: The package whose audit contract this checker enforces.
+SCOPE = "rafiki_tpu.advisor"
+
+#: Imports from these module prefixes taint a local name as
+#: "audit-capable": a call through any of them inside a hook counts
+#: as journaling the decision.
+AUDIT_MODULES = ("rafiki_tpu.obs.journal", "rafiki_tpu.obs.search")
+
+
+def _audit_names(tree: ast.Module) -> Set[str]:
+    """Local aliases bound to the journal/audit layer: the module
+    object (``from rafiki_tpu.obs.search import audit [as x]``), a
+    member (``from ...search.audit import record_propose``), or a
+    plain dotted import (``import rafiki_tpu.obs.search.audit as a``)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.startswith(AUDIT_MODULES):
+                for a in node.names:
+                    names.add(a.asname or a.name)
+            elif mod in ("rafiki_tpu.obs", "rafiki_tpu.obs.search"):
+                for a in node.names:
+                    full = f"{mod}.{a.name}"
+                    if full.startswith(AUDIT_MODULES):
+                        names.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith(AUDIT_MODULES):
+                    # `import rafiki_tpu.obs.search.audit` binds the
+                    # top package; calls go through the full chain.
+                    names.add(a.asname or a.name.split(".")[0])
+    return names
+
+
+def _is_decision_hook(fn: ast.AST) -> bool:
+    return (isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and (fn.name == "_feedback" or fn.name.startswith("_propose")))
+
+
+def _body_sans_docstring(fn) -> List[ast.stmt]:
+    body = list(fn.body)
+    if (body and isinstance(body[0], ast.Expr)
+            and isinstance(body[0].value, ast.Constant)
+            and isinstance(body[0].value.value, str)):
+        body = body[1:]
+    return body
+
+
+def _calls_audit(fn, audit_names: Set[str]) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name and (name in audit_names
+                     or name.split(".")[0] in audit_names):
+            return True
+    return False
+
+
+@register
+class UnjournaledDecision(Checker):
+    id = "RF011"
+    name = "unjournaled-decision"
+    severity = "error"
+    rationale = ("an advisor hook that proposes or ingests feedback "
+                 "without journaling through rafiki_tpu.obs.search.audit "
+                 "breaks `obs sweep` reconciliation for every sweep the "
+                 "engine serves — call the audit helper, or "
+                 "justify-suppress a layer whose inner engine journals")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not ctx.module_name.startswith(SCOPE):
+            return []
+        audit_names = _audit_names(ctx.tree)
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not _is_decision_hook(node):
+                continue
+            body = _body_sans_docstring(node)
+            if all(isinstance(s, ast.Raise) for s in body):
+                continue  # abstract hook: decides nothing
+            if not _calls_audit(node, audit_names):
+                findings.append(self.finding(
+                    ctx, node,
+                    f"`{node.name}` makes a search decision without "
+                    f"journaling it: no call into "
+                    f"rafiki_tpu.obs.search.audit (or the journal) in "
+                    f"its body, so `obs sweep` reconciliation will "
+                    f"flag every trial this engine serves — emit "
+                    f"audit.record_{'feedback' if node.name == '_feedback' else 'propose*'}"
+                    f"(...) before returning"))
+        return findings

@@ -13,6 +13,9 @@ Four layers on top of :mod:`rafiki_tpu.telemetry`:
 * :mod:`~rafiki_tpu.obs.perf` — perf sentinel: per-program cost
   profiling, SLO burn-rate alerting, step-time anomaly detection
   (docs/perf.md);
+* :mod:`~rafiki_tpu.obs.search` — search anatomy: advisor decision
+  audit, trial lineage, effective-trials-per-hour ledger
+  (docs/search_anatomy.md);
 
 plus :mod:`~rafiki_tpu.obs.prom` (Prometheus text exposition of the
 registry snapshot) and the ``python -m rafiki_tpu.obs`` CLI
@@ -29,7 +32,8 @@ import importlib
 
 from rafiki_tpu.obs import context, journal  # noqa: F401  (eager, dep-free)
 
-_LAZY = ("anatomy", "ledger", "perf", "prom", "recorder", "twin", "cli")
+_LAZY = ("anatomy", "ledger", "perf", "prom", "recorder", "search",
+         "twin", "cli")
 
 __all__ = ["context", "journal", *_LAZY, "configure_from_env"]
 

@@ -36,6 +36,15 @@ Subcommands (all read ``journal-*.jsonl*`` under ``--dir``, default
     serving [-n N] the continuous serving time-series: last N
                    ``serving/ts`` rollup rows (qps, p50/p99, shed
                    rate, queue depth, inflight, breaker state)
+    sweep [job]    reconstruct a whole sweep from the ``advisor/*``
+                   audit records: ordered proposals with acquisition
+                   breakdowns, scores, regret curve, advisor lift vs
+                   random with a bootstrap CI; exits 1 when a
+                   feedback/batch member has no propose record
+                   (docs/search_anatomy.md)
+    lineage [id]   walk one trial across incarnations/chips/packs
+                   (evict, backfill, resume, repack); ``--check``
+                   exits 1 on orphaned incarnations fleet-wide
 
 Output is one human line per record by default, ``--json`` for JSONL
 (pipe into jq). Exit code 1 when a requested trace has no records.
@@ -603,6 +612,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # Stdlib-only at import time; the engine loads inside the verbs.
     twin_cli.attach(sub)
+    from rafiki_tpu.obs.search import cli as search_cli
+
+    # Same discipline: attach is argparse-only, readers load lazily.
+    search_cli.attach(sub)
     args = p.parse_args(argv)
 
     if args.cmd == "replay":
@@ -629,4 +642,6 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_serving(log_dir, args.n, args.json)
     if args.cmd == "twin":
         return twin_cli.dispatch(args, log_dir, args.json)
+    if args.cmd in ("sweep", "lineage"):
+        return search_cli.dispatch(args, log_dir, args.json)
     return cmd_slowest(log_dir, args.n, args.json)

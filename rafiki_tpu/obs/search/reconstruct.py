@@ -1,0 +1,236 @@
+"""Rebuild a whole sweep from journals alone (``obs sweep``).
+
+The audit plane (:mod:`rafiki_tpu.obs.search.audit`) gives every
+proposal, batch draft and feedback a durable record; this module is
+the reader that turns a journal directory back into the sweep:
+ordered proposals with their acquisition breakdowns, the score each
+one earned, the best-so-far/regret curve, lineage roll-ups, and —
+when a random-engine baseline ran beside the main advisor — the
+advisor lift with a seeded bootstrap CI (the same
+:func:`~rafiki_tpu.obs.search.stats.bootstrap_ci` bench.py uses).
+
+Reconciliation is always on and loud: a ``feedback`` whose knobs-hash
+never appeared in a ``propose`` record, or a ``propose_batch`` member
+with no matching ``propose``, means an advisor decision escaped the
+audit trail — the CLI exits nonzero naming the hash, and the sweep
+smoke proves that path by doctoring a journal.
+
+Joins (all by the canonical knobs-hash):
+
+    advisor/propose --(hash)--> event/trial_started --(trial_id)-->
+        trial/epoch_eval + terminal events
+    advisor/feedback --(hash)--> advisor/propose (order-preserving)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from rafiki_tpu.obs.search import lineage as lineage_mod
+from rafiki_tpu.obs.search import stats
+from rafiki_tpu.obs.search.audit import knobs_hash
+
+SWEEP_SCHEMA_VERSION = 1
+
+
+def _group_key(rec: Dict[str, Any]) -> str:
+    if rec.get("advisor_id"):
+        return str(rec["advisor_id"])
+    return (f"{rec.get('engine', '?')}/{rec.get('role', '?')}-"
+            f"{rec.get('pid', 0)}/seed{rec.get('seed', 0)}")
+
+
+def _match(rec: Dict[str, Any], job: Optional[str]) -> bool:
+    if not job:
+        return True
+    j = str(job)
+    return (j in str(rec.get("job_id") or "")
+            or str(rec.get("advisor_id") or "").startswith(j))
+
+
+def reconstruct(records: List[Dict[str, Any]], job: Optional[str] = None,
+                boot_seed: int = 0,
+                n_boot: int = stats.DEFAULT_N_BOOT) -> Dict[str, Any]:
+    """Journal records -> sweep document. Never raises on bad input;
+    violations land in ``doc["reconciliation"]["errors"]``."""
+    adv = [r for r in records
+           if r.get("kind") == "advisor" and _match(r, job)]
+    groups: Dict[str, Dict[str, Any]] = {}
+    for r in adv:
+        g = groups.setdefault(_group_key(r), {
+            "engine": r.get("engine"), "seed": r.get("seed"),
+            "job_id": r.get("job_id"),
+            "proposes": [], "feedbacks": [], "batches": []})
+        if r.get("name") == "propose":
+            g["proposes"].append(r)
+        elif r.get("name") == "feedback":
+            g["feedbacks"].append(r)
+        elif r.get("name") == "propose_batch":
+            g["batches"].append(r)
+
+    errors: List[Dict[str, Any]] = []
+
+    # -- per-group audit reconciliation (loud) -------------------------------
+    for key, g in groups.items():
+        unmatched: Dict[str, int] = {}
+        for p in g["proposes"]:
+            h = p.get("knobs_hash")
+            unmatched[h] = unmatched.get(h, 0) + 1
+        for f in g["feedbacks"]:
+            h = f.get("knobs_hash")
+            if unmatched.get(h, 0) > 0:
+                unmatched[h] -= 1
+            else:
+                errors.append({
+                    "type": "feedback_without_propose", "group": key,
+                    "knobs_hash": h, "ts": f.get("ts"),
+                    "detail": "a score arrived for a knob assignment no "
+                              "advisor/propose record ever chose — an "
+                              "unjournaled decision or a torn journal"})
+        batch_budget: Dict[str, int] = {}
+        for p in g["proposes"]:
+            h = p.get("knobs_hash")
+            batch_budget[h] = batch_budget.get(h, 0) + 1
+        for b in g["batches"]:
+            for h in b.get("knobs_hashes") or []:
+                if batch_budget.get(h, 0) > 0:
+                    batch_budget[h] -= 1
+                else:
+                    errors.append({
+                        "type": "batch_member_without_propose",
+                        "group": key, "knobs_hash": h, "ts": b.get("ts"),
+                        "detail": "a propose_batch member has no matching "
+                                  "advisor/propose record"})
+
+    # -- pick the main sweep + random baseline -------------------------------
+    def _n(gk: str) -> int:
+        return len(groups[gk]["proposes"])
+
+    non_random = [k for k, g in groups.items() if g["engine"] != "random"]
+    main_key = (max(non_random, key=_n) if non_random
+                else (max(groups, key=_n) if groups else None))
+    baselines = [k for k, g in groups.items()
+                 if g["engine"] == "random" and k != main_key]
+    base_key = (max(baselines, key=lambda k: len(groups[k]["feedbacks"]))
+                if baselines else None)
+
+    # -- trial join: hash -> trial ids (order-preserving queues) -------------
+    trial_q: Dict[str, List[str]] = {}
+    for r in records:
+        if (r.get("kind") == "event" and r.get("name") == "trial_started"
+                and r.get("knobs") is not None):
+            trial_q.setdefault(knobs_hash(r["knobs"]), []).append(
+                str(r.get("trial_id")))
+    trials = lineage_mod.build(records)
+
+    doc: Dict[str, Any] = {
+        "sweep_schema_version": SWEEP_SCHEMA_VERSION,
+        "job": job,
+        "groups": {k: {"engine": g["engine"], "seed": g["seed"],
+                       "job_id": g["job_id"],
+                       "n_proposals": len(g["proposes"]),
+                       "n_feedbacks": len(g["feedbacks"]),
+                       "n_batches": len(g["batches"])}
+                   for k, g in groups.items()},
+        "main": main_key,
+        "baseline": base_key,
+    }
+
+    proposals: List[Dict[str, Any]] = []
+    scores: List[float] = []
+    n_doomed = 0
+    if main_key is not None:
+        g = groups[main_key]
+        doc["engine"] = g["engine"]
+        doc["seed"] = g["seed"]
+        # feedback join per hash, order-preserving
+        fb_q: Dict[str, List[Dict[str, Any]]] = {}
+        for f in g["feedbacks"]:
+            fb_q.setdefault(f.get("knobs_hash"), []).append(f)
+        for seq, p in enumerate(g["proposes"], start=1):
+            h = p.get("knobs_hash")
+            fb = fb_q.get(h)
+            f = fb.pop(0) if fb else None
+            tq = trial_q.get(h)
+            tid = tq.pop(0) if tq else None
+            t = trials.get(tid) if tid else None
+            doomed = bool(
+                (f and f.get("doomed"))
+                or (t and t["status"] in ("trial_errored",
+                                          "trial_diverged")))
+            row = {
+                "seq": seq, "ts": p.get("ts"), "knobs_hash": h,
+                "acquisition": p.get("acquisition"),
+                "trial_id": tid,
+                "score": f.get("score") if f else None,
+                "doomed": doomed,
+                "n_epoch_evals": (t or {}).get("n_epoch_evals"),
+                "status": (t or {}).get("status"),
+            }
+            proposals.append(row)
+            if f is not None and not doomed:
+                scores.append(float(f["score"]))
+            if doomed:
+                n_doomed += 1
+        doc["proposals"] = proposals
+        doc["curve"] = stats.regret_curve(scores)
+        ts_all = ([p.get("ts") for p in g["proposes"]]
+                  + [f.get("ts") for f in g["feedbacks"]])
+        ts_all = [t for t in ts_all if t is not None]
+        span_s = (max(ts_all) - min(ts_all)) if len(ts_all) > 1 else 0.0
+        doc.update({
+            "n_proposals": len(proposals),
+            "n_scored": len(scores),
+            "n_doomed": n_doomed,
+            "span_s": round(span_s, 6),
+            "best_score": doc["curve"]["best_score"],
+            "regret": doc["curve"]["mean_regret"],
+            "effective_trials_per_hour": (
+                round(len(scores) / (span_s / 3600.0), 4)
+                if span_s > 0 and scores else None),
+        })
+
+    # -- advisor lift vs the random baseline ---------------------------------
+    if main_key is not None and base_key is not None:
+        base_scores = [float(f["score"])
+                       for f in groups[base_key]["feedbacks"]
+                       if not f.get("doomed")]
+        n_pair = min(len(scores), len(base_scores))
+        if n_pair:
+            diffs = [scores[i] - base_scores[i] for i in range(n_pair)]
+            ci = stats.bootstrap_ci(diffs, n_boot=n_boot, seed=boot_seed)
+            doc["lift"] = ci
+            doc["advisor_lift"] = ci["mean"]
+            doc["lift_ci_low"] = ci["lo"]
+            doc["lift_ci_high"] = ci["hi"]
+
+    # -- lineage roll-up ------------------------------------------------------
+    orphans = lineage_mod.reconcile(trials)
+    doc["lineage"] = {
+        "n_trials": len(trials),
+        "n_evictions": sum(t["n_evictions"] for t in trials.values()),
+        "n_resumes": sum(t["n_resumes"] for t in trials.values()),
+        "n_backfilled": sum(1 for t in trials.values() if t["backfilled"]),
+        "n_multi_incarnation": sum(
+            1 for t in trials.values() if t["n_incarnations"] > 1),
+        "orphans": orphans,
+    }
+
+    doc["reconciliation"] = {"ok": not errors, "errors": errors}
+    return doc
+
+
+def artifact(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """The trendable SWEEP_r*.json slice of a sweep document — headline
+    keys at top level for ``bench_report --sweep`` (polarities live in
+    its SWEEP_METRICS table)."""
+    keys = ("sweep_schema_version", "job", "engine", "seed",
+            "n_proposals", "n_scored", "n_doomed", "span_s",
+            "best_score", "regret", "effective_trials_per_hour",
+            "advisor_lift", "lift_ci_low", "lift_ci_high")
+    out = {k: doc.get(k) for k in keys if doc.get(k) is not None}
+    out["sweep_schema_version"] = doc.get("sweep_schema_version",
+                                          SWEEP_SCHEMA_VERSION)
+    if not doc.get("reconciliation", {}).get("ok", False):
+        out["error"] = "sweep reconciliation failed"
+    return out

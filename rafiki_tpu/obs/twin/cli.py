@@ -63,6 +63,10 @@ def attach(sub: argparse._SubParsersAction) -> None:
                     help="sweep axis (repeatable), e.g. workers=1,2,4,8")
     sp.add_argument("--fleet", action="store_true",
                     help="also run the RAFIKI_SLO smallest-fleet search")
+    sp.add_argument("--suggest-slo", action="store_true",
+                    help="emit an auto-tuned RAFIKI_SLO spec set "
+                         "anchored at the smallest-fleet knee "
+                         "(implies --fleet)")
 
     sp = tsub.add_parser("validate",
                          help="replay a captured bench_serving run; "
@@ -164,9 +168,11 @@ def cmd_sweep(args, log_dir: str, as_json: bool) -> int:
                         chaos_spec=args.chaos)
     doc: Dict[str, Any] = {"grid": {k: list(v) for k, v in grid.items()},
                            "seed": args.seed, "rows": rows}
-    if args.fleet:
+    if args.fleet or args.suggest_slo:
         doc["fleet"] = whatif.fleet_search(cal, base, arrivals,
                                            seed=args.seed)
+    if args.suggest_slo:
+        doc["suggested_slo"] = whatif.suggest_slo(doc["fleet"])
     if as_json:
         print(json.dumps(doc, default=str))
         return 0
@@ -176,7 +182,7 @@ def cmd_sweep(args, log_dir: str, as_json: bool) -> int:
         print(f"{knobstr:<32} qps={row['qps']:>8} p50={row['p50_ms']}ms "
               f"p99={row['p99_ms']}ms shed={row['shed_rate']} "
               f"saturates={row['first_saturating']}")
-    if args.fleet:
+    if "fleet" in doc:
         f = doc["fleet"]
         t = f["targets"]
         if f["satisfied"]:
@@ -187,6 +193,9 @@ def cmd_sweep(args, log_dir: str, as_json: bool) -> int:
             print(f"fleet: NO worker count up to {len(f['scanned'])} "
                   f"meets p99<={t['p99_ms']}ms shed<={t['shed_rate']}; "
                   f"last saturates {f['first_saturating']}")
+    if "suggested_slo" in doc:
+        print("suggested RAFIKI_SLO (paste as the env value):")
+        print(f"  {json.dumps(doc['suggested_slo'])}")
     return 0
 
 

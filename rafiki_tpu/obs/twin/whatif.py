@@ -141,6 +141,66 @@ def fleet_search(cal: Calibration, base: TwinConfig,
                                  if scanned else None)}
 
 
+#: suggest_slo knee headrooms: the p99 budget sits 25% above the knee
+#: fleet's simulated p99 (normal jitter must not page), the shed budget
+#: at 2x observed, clamped to [1%, 25%] (a zero-shed sim must not emit
+#: an unmeetable 0.0 budget; a melting one must not normalize 40% shed).
+P99_HEADROOM = 1.25
+SHED_HEADROOM = 2.0
+SHED_FLOOR, SHED_CEIL = 0.01, 0.25
+
+
+def suggest_slo(fleet: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Auto-tuned ``RAFIKI_SLO`` spec dicts from a fleet-search result:
+    thresholds anchored at the smallest compliant fleet (the knee),
+    where latency is highest among compliant picks — budgets derived
+    there hold for any larger fleet. Output round-trips through
+    ``SloSpec.from_dict`` / ``RAFIKI_SLO=<json>`` byte-identically for
+    the same fleet doc (scripts/twin_smoke.py asserts this), so an
+    operator can paste it straight into the live burn-rate engine.
+
+    When no scanned fleet met the default targets, anchor on the best
+    scanned p99 instead: the suggestion then documents the gap rather
+    than inventing a budget the hardware cannot meet."""
+    scanned = fleet.get("scanned") or []
+    rows = [r for r in scanned if r.get("p99_ms") is not None]
+    if not rows:
+        raise ValueError("fleet search completed no requests; "
+                         "no knee to tune an SLO against")
+    knee = None
+    if fleet.get("workers") is not None:
+        for r in rows:
+            if r.get("workers") == fleet["workers"]:
+                knee = r
+                break
+    if knee is None:
+        knee = min(rows, key=lambda r: float(r["p99_ms"]))
+    p99_s = round(float(knee["p99_ms"]) * P99_HEADROOM / 1000.0, 6)
+    n = knee.get("requests") or 0
+    failed = (knee.get("shed") or 0) + (knee.get("errors") or 0)
+    observed = failed / n if n else 0.0
+    shed = round(min(max(observed * SHED_HEADROOM, SHED_FLOOR),
+                     SHED_CEIL), 6)
+    anchor = (f"{knee['workers']}-worker knee"
+              if fleet.get("workers") is not None
+              else f"best scanned fleet ({knee['workers']} workers, "
+                   f"targets unmet)")
+    return [
+        {"name": "gateway_p99_latency",
+         "source": "hist_p99:gateway.predict_s",
+         "threshold": p99_s, "op": ">",
+         "description": f"auto-tuned at the {anchor}: sim p99 "
+                        f"{knee['p99_ms']}ms x{P99_HEADROOM} headroom"},
+        {"name": "gateway_shed_rate",
+         "source": "ratio:gateway.shed/gateway.shed+gateway.admitted",
+         "threshold": shed, "op": ">",
+         "description": f"auto-tuned at the {anchor}: observed "
+                        f"fail rate {round(observed, 6)} "
+                        f"x{SHED_HEADROOM}, clamped to "
+                        f"[{SHED_FLOOR}, {SHED_CEIL}]"},
+    ]
+
+
 def parse_grid(items: List[str]) -> Dict[str, List[Any]]:
     """CLI grid grammar: ``knob=v1,v2,...`` per item. Values coerce to
     int, then float, then the literal string; ``none`` -> None (the

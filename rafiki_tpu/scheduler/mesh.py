@@ -268,6 +268,12 @@ class MeshSweepScheduler:
             advisor_id = self.advisors.create_advisor(
                 model_cls.get_knob_config(), kind=advisor_kind,
                 advisor_id=sub.get("advisor_id") or None)
+            try:
+                # Stamp the job onto the engine so its advisor/*
+                # journal records answer `obs sweep <job>` directly.
+                self.advisors.get(advisor_id).job_id = job_id
+            except KeyError:
+                pass
             self.store.update_sub_train_job(sub["id"], advisor_id=advisor_id,
                                             status=TrainJobStatus.RUNNING.value)
             handle = InProcAdvisorHandle(self.advisors, advisor_id)
@@ -448,6 +454,13 @@ class MeshSweepScheduler:
                     for tid in orphans:
                         self.store.mark_trial_as_errored(
                             tid, "mesh sweep lost every chip")
+                        # Close the journal lineage too: without this
+                        # event the trial reads as an orphaned
+                        # incarnation in `obs lineage --check` even
+                        # though the store knows its fate.
+                        events.emit("trial_errored", trial_id=tid,
+                                    worker_id=r.worker.worker_id,
+                                    error="mesh sweep lost every chip")
                     _journal.record("mesh", "repack_failed", job_id=job_id,
                                     chip=r.index, orphans=orphans)
                     continue

@@ -34,6 +34,7 @@ from rafiki_tpu.obs import context as trace_context
 from rafiki_tpu.obs import health as _health
 from rafiki_tpu.obs.journal import journal
 from rafiki_tpu.obs.ledger import ledger
+from rafiki_tpu.obs.search import audit as search_audit
 from rafiki_tpu.store import MetaStore, ParamsStore
 from rafiki_tpu.utils.events import events
 
@@ -271,6 +272,9 @@ class TrainWorker:
                         capsule=v.get("capsule"),
                         diagnosis=v.get("diagnosis"))
             _health.note_contained()
+            # Doomed BEFORE the consolation feedback: the search ledger
+            # charges this trial's wall to doomed_s, not scored_s.
+            search_audit.note_doomed(knobs)
             try:
                 self.advisor.feedback(0.0, knobs)
             except Exception:
@@ -284,6 +288,7 @@ class TrainWorker:
                         error=err.splitlines()[-1] if err else "")
             # Feed the advisor a floor score so it learns to avoid the
             # region instead of re-proposing it (reference just skips).
+            search_audit.note_doomed(knobs)
             try:
                 self.advisor.feedback(0.0, knobs)
             except Exception:
@@ -761,6 +766,7 @@ class PackedTrialRunner:
                             error=err.splitlines()[-1] if err else "")
                 # Same floor-score contract as the serial path: the
                 # advisor learns to avoid the region.
+                search_audit.note_doomed(kn)
                 try:
                     w.advisor.feedback(0.0, kn)
                 except Exception:
@@ -811,6 +817,7 @@ class PackedTrialRunner:
                             capsule=v.get("capsule"),
                             diagnosis=v.get("diagnosis"))
                 _health.note_contained()
+                search_audit.note_doomed(kn)
                 try:
                     w.advisor.feedback(0.0, kn)
                 except Exception:

@@ -69,12 +69,30 @@ TWIN_METRICS = {
     "p99_err": "lower",
 }
 
+#: Sweep-anatomy rounds (``--sweep``): SWEEP_r*.json artifacts from
+#: ``python -m rafiki_tpu.obs sweep --out`` (docs/search_anatomy.md).
+#: Reconciliation-failed rounds stamp ``error`` and read as no-data —
+#: a sweep whose audit trail leaked is not a zero-regret sweep.
+SWEEP_METRICS = {
+    "effective_trials_per_hour": "higher",
+    "best_score": "higher",
+    "regret": "lower",
+    "advisor_lift": "higher",
+}
+
 #: Metrics where 0 is a legitimate measurement, not "did not run" —
 #: a clean serving round genuinely sheds nothing, a 1-worker round
-#: has zero fan-out cost, and a perfectly calibrated twin has zero
-#: prediction error. (Throughput-style metrics keep the strict
-#: v > 0 rule: their zeros mean a dead backend.)
-ZERO_OK = {"shed_rate", "ensemble_fanout_cost_ms", "p50_err", "p99_err"}
+#: has zero fan-out cost, a perfectly calibrated twin has zero
+#: prediction error, and a sweep that found the optimum early has
+#: zero regret. (Throughput-style metrics keep the strict v > 0
+#: rule: their zeros mean a dead backend.)
+ZERO_OK = {"shed_rate", "ensemble_fanout_cost_ms", "p50_err", "p99_err",
+           "regret", "advisor_lift"}
+
+#: Metrics that are legitimately signed: a GP that *hurt* the sweep
+#: has negative lift, and that is a measurement the trend must carry,
+#: not a dead-backend null.
+NEG_OK = {"advisor_lift"}
 
 
 def _payload_from_tail(tail: Any) -> Optional[Dict[str, Any]]:
@@ -113,7 +131,8 @@ def load_round(path: str) -> Dict[str, Any]:
         out["error"] = "artifact is not a JSON object"
         return out
     if ("metric" in doc or "headline" in doc or "qps" in doc
-            or "schema_version" in doc or "twin_schema_version" in doc):
+            or "schema_version" in doc or "twin_schema_version" in doc
+            or "sweep_schema_version" in doc):
         # A raw bench.py / bench_serving.py result saved directly, no
         # driver wrapper.
         out["payload"], out["source"] = doc, "raw"
@@ -167,6 +186,16 @@ def twin_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
             if payload.get(k) is not None}
 
 
+def sweep_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """The sweep-anatomy block: ``obs sweep --out`` artifacts carry the
+    headline keys at top level. A reconciliation-failed artifact stamps
+    ``error`` and yields nothing — no-data, not a perfect sweep."""
+    if not isinstance(payload, dict) or payload.get("error"):
+        return {}
+    return {k: payload.get(k) for k in SWEEP_METRICS
+            if payload.get(k) is not None}
+
+
 def health_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     """The ``detail.health`` numerics block (docs/health.md), when the
     artifact carries one. Trended as ADVISORY context — a round with
@@ -178,10 +207,11 @@ def health_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     return h if isinstance(h, dict) else {}
 
 
-def _measurable(v: Any, zero_ok: bool = False) -> bool:
+def _measurable(v: Any, zero_ok: bool = False,
+                neg_ok: bool = False) -> bool:
     if not isinstance(v, (int, float)) or isinstance(v, bool):
         return False
-    return v > 0 or (zero_ok and v == 0)
+    return v > 0 or (zero_ok and v == 0) or (neg_ok and v < 0)
 
 
 def trend(rounds: List[Dict[str, Any]], tolerance: float,
@@ -192,11 +222,13 @@ def trend(rounds: List[Dict[str, Any]], tolerance: float,
     out: Dict[str, Dict[str, Any]] = {}
     for metric, direction in (metrics or METRICS).items():
         zero_ok = metric in ZERO_OK
+        neg_ok = metric in NEG_OK
         points = []
         for r in rounds:
             v = headline_fn(r["payload"]).get(metric)
-            points.append({"round": r["round"],
-                           "value": v if _measurable(v, zero_ok) else None})
+            points.append({
+                "round": r["round"],
+                "value": v if _measurable(v, zero_ok, neg_ok) else None})
         measured = [p for p in points if p["value"] is not None]
         entry: Dict[str, Any] = {"direction": direction,
                                  "trajectory": points,
@@ -213,8 +245,9 @@ def trend(rounds: List[Dict[str, Any]], tolerance: float,
             # Signed fraction, positive = worse, in units of the best
             # prior value — one tolerance knob works for both signs.
             # ZERO_OK metrics can have best == 0 (a clean round shed
-            # nothing): fall back to an absolute delta so going from
-            # 0 to anything still registers instead of dividing by 0.
+            # nothing) and NEG_OK ones a negative best (a GP that hurt):
+            # fall back to an absolute delta so going from 0 to anything
+            # still registers instead of dividing by 0 (or flipping sign).
             denom = best if best > 0 else 1.0
             delta = ((best - latest) if direction == "higher"
                      else (latest - best)) / denom
@@ -245,12 +278,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--twin", action="store_true",
                    help="trend twin-validation rounds (TWIN_r*.json "
                         "default glob, p50_err/p99_err lower-better)")
+    p.add_argument("--sweep", action="store_true",
+                   help="trend sweep-anatomy rounds (SWEEP_r*.json "
+                        "default glob, trials-per-hour/best-score higher, "
+                        "regret lower, advisor_lift signed)")
     args = p.parse_args(argv)
 
-    if args.serving and args.twin:
-        print(json.dumps({"error": "--serving and --twin are exclusive"}))
+    if sum((args.serving, args.twin, args.sweep)) > 1:
+        print(json.dumps(
+            {"error": "--serving, --twin and --sweep are exclusive"}))
         return 2
-    if args.twin:
+    if args.sweep:
+        metric_set, headline_fn = SWEEP_METRICS, sweep_headline_of
+        pattern = "SWEEP_r*.json"
+    elif args.twin:
         metric_set, headline_fn = TWIN_METRICS, twin_headline_of
         pattern = "TWIN_r*.json"
     elif args.serving:
@@ -279,7 +320,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         "schema_version": REPORT_SCHEMA_VERSION,
         "tolerance": args.tolerance,
         "n_rounds": len(rounds),
-        "mode": ("twin" if args.twin
+        "mode": ("sweep" if args.sweep
+                 else "twin" if args.twin
                  else "serving" if args.serving else "training"),
         "rounds": [{"round": r["round"], "rc": r["rc"],
                     "source": r["source"],

@@ -65,6 +65,14 @@ env JAX_PLATFORMS=cpu python scripts/serving_obs_smoke.py > /tmp/_serving_obs_sm
 # and gate the TWIN_r* error trend both ways (docs/twin.md). ~15s.
 env JAX_PLATFORMS=cpu python scripts/twin_smoke.py > /tmp/_twin_smoke.json \
   || { echo "TIER1 TWIN SMOKE FAILED (see /tmp/_twin_smoke.json)"; exit 1; }
+# Search-anatomy smoke: a seeded 12-trial GP sweep must reconstruct
+# end to end from its journals alone (`obs sweep` — every proposal
+# audited, regret non-increasing, lift CI present), a doctored journal
+# missing one advisor/propose must fail reconciliation loudly, and
+# bench_report --sweep must gate the SWEEP_r* trend both ways
+# (docs/search_anatomy.md). ~10s.
+env JAX_PLATFORMS=cpu python scripts/sweep_smoke.py > /tmp/_sweep_smoke.json \
+  || { echo "TIER1 SWEEP SMOKE FAILED (see /tmp/_sweep_smoke.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Search-anatomy CI smoke: a seeded sweep must reconstruct from its
+journals alone, and a doctored journal must fail reconciliation loudly
+(docs/search_anatomy.md).
+
+Three phases, ~10s total:
+
+  1. **Sweep + reconstruct** — a 12-trial GpAdvisor sweep and a
+     12-trial RandomAdvisor baseline over a synthetic quadratic
+     objective, journaled to a fresh dir; then ``python -m
+     rafiki_tpu.obs sweep --out SWEEP_r01.json`` as a real subprocess
+     reading ONLY the journals. Every proposal must carry its
+     acquisition breakdown, the regret curve must be non-increasing,
+     and the GP-vs-random lift must come with its bootstrap CI.
+  2. **Doctored journal** — the same dir minus one ``advisor/propose``
+     line must exit non-zero with a reconciliation failure naming the
+     escaped decision on stderr: feedback for a proposal that was
+     never journaled means the audit trail leaked, and the sweep plane
+     must refuse to pretend otherwise.
+  3. **Report gate, both polarities** — ``bench_report --sweep`` over
+     synthetic SWEEP_r*.json rounds: an improving trend exits 0, a
+     collapsed round (regret up, trials/hour down) exits 1, and a
+     reconciliation-failed round reads as no-data, not a
+     zero-regret sweep.
+
+Output: one JSON object on stdout. Exit 0 when every assertion holds;
+1 otherwise — this is a CI gate (scripts/check_tier1.sh).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N_TRIALS = 12
+
+
+def _run(cmd, timeout=120):
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          env=dict(os.environ), timeout=timeout, cwd=REPO)
+
+
+def _objective(knobs) -> float:
+    """Smooth quadratic with one optimum inside the box — a GP can
+    exploit it within 12 trials, so the reconstruction has a real
+    regret curve to check."""
+    lr_term = (math.log10(knobs["lr"]) + 2.5) ** 2 * 0.2
+    unit_term = abs(knobs["units"] - 32) / 64 * 0.2
+    return round(1.0 - lr_term - unit_term, 6)
+
+
+def _journaled_sweep(log_dir):
+    from rafiki_tpu.advisor.gp import GpAdvisor
+    from rafiki_tpu.advisor.random_advisor import RandomAdvisor
+    from rafiki_tpu.model.knobs import FixedKnob, FloatKnob, IntegerKnob
+    from rafiki_tpu.obs.journal import journal
+
+    kc = {"lr": FloatKnob(1e-4, 3e-2, is_exp=True),
+          "units": IntegerKnob(4, 64),
+          "b": FixedKnob(8)}
+    journal.configure(log_dir, role="sweep")
+    try:
+        for adv in (GpAdvisor(kc, seed=5, n_initial=4),
+                    RandomAdvisor(kc, seed=105)):
+            for _ in range(N_TRIALS):
+                knobs = adv.propose()
+                adv.feedback(_objective(knobs), knobs)
+    finally:
+        journal.close()
+
+
+def phase_reconstruct(results):
+    log_dir = tempfile.mkdtemp(prefix="sweep_smoke_")
+    _journaled_sweep(log_dir)
+    out = os.path.join(log_dir, "SWEEP_r01.json")
+    # The reader is a real subprocess with NOTHING but the journal dir:
+    # the whole sweep must reconstruct from records alone.
+    r = _run([sys.executable, "-m", "rafiki_tpu.obs", "--dir", log_dir,
+              "--json", "sweep", "--out", out])
+    try:
+        doc = json.loads(r.stdout)
+    except ValueError:
+        doc = {}
+    proposals = doc.get("proposals") or []
+    regret = (doc.get("curve") or {}).get("regret") or []
+    ci = doc.get("lift") or {}
+    ph = {
+        "rc": r.returncode,
+        "n_proposals": len(proposals),
+        "every_proposal_audited": bool(proposals) and all(
+            p.get("acquisition", {}).get("phase") for p in proposals),
+        "regret_nonincreasing": bool(regret) and all(
+            a >= b for a, b in zip(regret, regret[1:])),
+        "final_regret": regret[-1] if regret else None,
+        "lift_ci": [ci.get("lo"), ci.get("hi")],
+        "reconciliation_ok": (doc.get("reconciliation") or {}).get("ok"),
+        "artifact_written": os.path.exists(out),
+        "ok": False,
+    }
+    ph["ok"] = (ph["rc"] == 0 and ph["n_proposals"] == N_TRIALS
+                and ph["every_proposal_audited"]
+                and ph["regret_nonincreasing"]
+                and ph["reconciliation_ok"] is True
+                and ph["artifact_written"]
+                and None not in ph["lift_ci"])
+    if not ph["ok"]:
+        ph["stderr"] = r.stderr[-400:]
+    results["reconstruct"] = ph
+    return log_dir if ph["ok"] else None
+
+
+def phase_doctored(results, log_dir):
+    """Strip ONE advisor/propose line: the remaining feedback is now a
+    decision with no journaled origin, and reconciliation must fail
+    loudly instead of rendering a plausible-looking sweep."""
+    doctored = tempfile.mkdtemp(prefix="sweep_smoke_doctored_")
+    cut = 0
+    for name in os.listdir(log_dir):
+        if not name.endswith(".jsonl"):
+            continue
+        kept = []
+        for line in open(os.path.join(log_dir, name)):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                rec = {}
+            if (not cut and rec.get("kind") == "advisor"
+                    and rec.get("name") == "propose"
+                    and rec.get("engine") == "gp"):
+                cut += 1
+                continue
+            kept.append(line)
+        with open(os.path.join(doctored, name), "w") as f:
+            f.writelines(kept)
+    r = _run([sys.executable, "-m", "rafiki_tpu.obs", "--dir", doctored,
+              "--json", "sweep"])
+    ph = {
+        "lines_cut": cut,
+        "rc": r.returncode,
+        "fails_loudly": "RECONCILIATION FAILED" in r.stderr,
+        "names_escape": "feedback_without_propose" in r.stderr,
+        "ok": (cut == 1 and r.returncode != 0
+               and "RECONCILIATION FAILED" in r.stderr
+               and "feedback_without_propose" in r.stderr),
+    }
+    if not ph["ok"]:
+        ph["stderr"] = r.stderr[-400:]
+    results["doctored"] = ph
+    return ph["ok"]
+
+
+def phase_report_gate(results, log_dir):
+    """bench_report --sweep over synthetic rounds, both polarities,
+    seeded from the real r01 artifact so the trend exercises the
+    actual SWEEP schema."""
+    td = tempfile.mkdtemp(prefix="sweep_rounds_")
+    base = json.load(open(os.path.join(log_dir, "SWEEP_r01.json")))
+
+    def _round(n, doc):
+        path = os.path.join(td, f"SWEEP_r{n:02d}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+    improving = [
+        _round(1, dict(base, effective_trials_per_hour=400.0, regret=0.08)),
+        _round(2, dict(base, effective_trials_per_hour=440.0, regret=0.05)),
+        _round(3, {"sweep_schema_version": base.get("sweep_schema_version"),
+                   "error": "sweep reconciliation failed"}),
+        _round(4, dict(base, effective_trials_per_hour=450.0, regret=0.04)),
+    ]
+    ok_run = _run([sys.executable, "scripts/bench_report.py", "--sweep",
+                   *improving])
+    regressed = improving + [
+        _round(5, dict(base, effective_trials_per_hour=200.0, regret=0.30))]
+    bad_run = _run([sys.executable, "scripts/bench_report.py", "--sweep",
+                    *regressed])
+    try:
+        ok_doc = json.loads(ok_run.stdout)
+        bad_doc = json.loads(bad_run.stdout)
+    except ValueError:
+        ok_doc, bad_doc = {}, {}
+    error_round_has_data = any(
+        r.get("has_data") for r in ok_doc.get("rounds", [])
+        if str(r.get("round", "")).endswith("r03.json"))
+    ph = {
+        "ok_rc": ok_run.returncode,
+        "ok_verdict": ok_doc.get("verdict"),
+        "regressed_rc": bad_run.returncode,
+        "regressed_metrics": bad_doc.get("regressed"),
+        "error_round_counted": error_round_has_data,
+        "ok": (ok_run.returncode == 0 and ok_doc.get("verdict") == "ok"
+               and bad_run.returncode == 1
+               and "effective_trials_per_hour" in (bad_doc.get("regressed")
+                                                   or [])
+               and "regret" in (bad_doc.get("regressed") or [])
+               and not error_round_has_data),
+    }
+    if not ph["ok"]:
+        ph["ok_stderr"] = ok_run.stderr[-300:]
+        ph["regressed_stderr"] = bad_run.stderr[-300:]
+    results["report_gate"] = ph
+    return ph["ok"]
+
+
+def main() -> int:
+    results = {}
+    log_dir = phase_reconstruct(results)
+    ok = log_dir is not None
+    if ok:
+        ok = phase_doctored(results, log_dir) and ok
+    if ok:
+        ok = phase_report_gate(results, log_dir) and ok
+    results["ok"] = ok
+    print(json.dumps(results))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,8 +18,10 @@ Five phases, real subprocesses throughout:
      must FAIL (exit 1) — a twin that cannot detect a halved forward
      time validates nothing.
   4. **Deterministic sweep** — ``obs twin sweep`` over a worker grid,
-     run twice with one seed, must emit byte-identical JSON, and each
-     row must name its first-saturating resource.
+     run twice with one seed, must emit byte-identical JSON, each row
+     must name its first-saturating resource, and ``--suggest-slo``
+     must emit a 2-spec auto-tuned RAFIKI_SLO set that round-trips
+     through the live burn-rate engine's own parser.
   5. **Report gate, both polarities** — ``bench_report --twin`` over
      synthetic TWIN_r*.json rounds: an improving error trend exits 0,
      a regressed round (calibration drift) exits 1, and an
@@ -131,9 +133,29 @@ def phase_validate(results, log_dir, bundle):
     return good_doc if ph["ok"] else None
 
 
+def _slo_roundtrip(specs):
+    """The suggested spec set must survive the live engine's own
+    parser: RAFIKI_SLO=json.dumps(specs) -> _specs_from_env -> the
+    same names/thresholds. A suggestion the burn-rate engine cannot
+    load is a paste-time landmine, not an SLO."""
+    from rafiki_tpu.obs.perf.slo import _specs_from_env
+
+    old = os.environ.get("RAFIKI_SLO")
+    os.environ["RAFIKI_SLO"] = json.dumps(specs)
+    try:
+        parsed = _specs_from_env() or []
+    finally:
+        if old is None:
+            os.environ.pop("RAFIKI_SLO", None)
+        else:
+            os.environ["RAFIKI_SLO"] = old
+    return ([(s.name, s.threshold) for s in parsed]
+            == [(d["name"], d["threshold"]) for d in specs])
+
+
 def phase_sweep(results, log_dir):
     args = ("sweep", "--seed", SEED, "--qps", "60", "--duration", "4",
-            "--grid", "workers=1,2,4", "--fleet")
+            "--grid", "workers=1,2,4", "--fleet", "--suggest-slo")
     a = _twin(log_dir, *args)
     b = _twin(log_dir, *args)
     try:
@@ -141,6 +163,7 @@ def phase_sweep(results, log_dir):
     except ValueError:
         doc = {}
     rows = doc.get("rows") or []
+    specs = doc.get("suggested_slo") or []
     ph = {
         "rc": a.returncode,
         "rows": len(rows),
@@ -148,11 +171,15 @@ def phase_sweep(results, log_dir):
         "saturating_named": bool(rows) and all(
             r.get("first_saturating") for r in rows),
         "fleet_workers": (doc.get("fleet") or {}).get("workers"),
+        "suggested_slo_specs": len(specs),
+        "suggested_slo_parses": bool(specs) and _slo_roundtrip(specs),
         "ok": False,
     }
     ph["ok"] = (ph["rc"] == 0 and ph["rows"] == 3 and ph["deterministic"]
                 and ph["saturating_named"]
-                and ph["fleet_workers"] is not None)
+                and ph["fleet_workers"] is not None
+                and ph["suggested_slo_specs"] == 2
+                and ph["suggested_slo_parses"])
     if not ph["ok"]:
         ph["stderr"] = a.stderr[-300:]
     results["sweep"] = ph

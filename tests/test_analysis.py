@@ -28,8 +28,8 @@ def _analyze_snippet(tmp_path, source, name="snippet.py", select=None):
 
 
 def test_all_builtin_checkers_registered():
-    assert {"RF001", "RF002", "RF003", "RF004", "RF005",
-            "RF006", "RF007", "RF008", "RF009", "RF010"} <= set(REGISTRY)
+    assert {"RF001", "RF002", "RF003", "RF004", "RF005", "RF006",
+            "RF007", "RF008", "RF009", "RF010", "RF011"} <= set(REGISTRY)
 
 
 # ---------------------------------------------------------------------------
@@ -785,3 +785,113 @@ def test_cli_json_and_exit_codes(tmp_path, capsys):
     assert main([str(good), "--format", "json"]) == 0
 
     assert main([str(good), "--select", "NOPE01"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# RF011 unjournaled-decision
+# ---------------------------------------------------------------------------
+
+
+def _advisor_snippet(tmp_path, source, select=None):
+    """Write the snippet INSIDE a rafiki_tpu/advisor/ package tree so
+    module_name_for resolves it into RF011's scope."""
+    adv = tmp_path / "rafiki_tpu" / "advisor"
+    adv.mkdir(parents=True)
+    for d in (tmp_path / "rafiki_tpu", adv):
+        (d / "__init__.py").write_text("")
+    f = adv / "snippet.py"
+    f.write_text(textwrap.dedent(source))
+    return analyze_paths([str(f)], select=select)
+
+
+RF011_BAD = """
+    class SneakyAdvisor:
+        def _propose(self):
+            return {"lr": 0.1}
+
+        def _feedback(self, score, knobs):
+            self._X.append(knobs)
+    """
+
+
+def test_rf011_fires_on_unjournaled_hooks(tmp_path):
+    r = _advisor_snippet(tmp_path, RF011_BAD)
+    found = [f for f in r.unsuppressed if f.checker_id == "RF011"]
+    assert len(found) == 2
+    assert all(f.severity == "error" for f in found)
+    assert "obs sweep" in found[0].message
+
+
+def test_rf011_scoped_to_advisor_package_only(tmp_path):
+    # The identical source OUTSIDE rafiki_tpu/advisor/ is legal: the
+    # audit contract binds engines, not arbitrary code with _propose.
+    r = _analyze_snippet(tmp_path, RF011_BAD)
+    assert "RF011" not in _ids(r)
+
+
+def test_rf011_quiet_when_hooks_journal(tmp_path):
+    r = _advisor_snippet(tmp_path, """
+        from rafiki_tpu.obs.search import audit
+
+        class GoodAdvisor:
+            def _propose(self):
+                knobs = {"lr": 0.1}
+                audit.record_propose(self, knobs, {"phase": "fixed"})
+                return knobs
+
+            def _propose_batch(self, n):
+                out = [self._propose() for _ in range(n)]
+                audit.record_propose_batch(self, n, out, strategy="seq")
+                return out
+
+            def _feedback(self, score, knobs):
+                audit.record_feedback(self, score, knobs)
+        """)
+    assert "RF011" not in _ids(r)
+
+
+def test_rf011_quiet_on_member_import_and_raw_journal(tmp_path):
+    # Both alias shapes count: a member imported from audit, and the
+    # journal handle itself.
+    r = _advisor_snippet(tmp_path, """
+        from rafiki_tpu.obs.journal import journal
+        from rafiki_tpu.obs.search.audit import record_feedback
+
+        class DirectAdvisor:
+            def _propose(self):
+                knobs = {"lr": 0.1}
+                journal.record("advisor", "propose", knobs=knobs)
+                return knobs
+
+            def _feedback(self, score, knobs):
+                record_feedback(self, score, knobs)
+        """)
+    assert "RF011" not in _ids(r)
+
+
+def test_rf011_exempts_abstract_raise_only_hooks(tmp_path):
+    # BaseAdvisor._propose's shape: a docstring plus a bare raise
+    # decides nothing, so there is nothing to journal.
+    r = _advisor_snippet(tmp_path, """
+        class AbstractAdvisor:
+            def _propose(self):
+                \"\"\"Engines override.\"\"\"
+                raise NotImplementedError
+        """)
+    assert "RF011" not in _ids(r)
+
+
+def test_rf011_justified_suppression_honored(tmp_path):
+    r = _advisor_snippet(tmp_path, """
+        class ShimAdvisor:
+            # lint: disable=RF011 — test shim, inner engine journals
+            def _feedback(self, score, knobs):
+                self.inner.feedback(score, knobs)
+        """)
+    assert "RF011" not in _ids(r)
+
+
+def test_rf011_current_tree_is_clean():
+    r = analyze_paths([os.path.join(REPO, "rafiki_tpu")], select=["RF011"])
+    mine = [f for f in r.unsuppressed if f.checker_id == "RF011"]
+    assert mine == [], [f"{f.path}:{f.line}" for f in mine]

@@ -295,6 +295,18 @@ _SNAPSHOT = {
         "window_s": 30.0,
         "slowest_s": 0.040251,
     },
+    "search": {
+        "n_proposed": 12,
+        "n_scored": 9,
+        "n_doomed": 2,
+        "n_pending": 1,
+        "scored_wall_s": 54.0,
+        "doomed_wall_s": 6.0,
+        "elapsed_s": 60.0,
+        "effective_trials_per_hour": 540.0,
+        "regret": 0.0834,
+        "best_score": 0.91,
+    },
 }
 
 
