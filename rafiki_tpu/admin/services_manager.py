@@ -21,6 +21,7 @@ InferenceWorker thread serves it off the bus; a Predictor fronts them
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -35,6 +36,7 @@ from rafiki_tpu.constants import (
 )
 from rafiki_tpu.gateway import Gateway, GatewayConfig
 from rafiki_tpu.model.base import load_model_class
+from rafiki_tpu.obs.journal import journal as _journal
 from rafiki_tpu.predictor.predictor import Predictor
 from rafiki_tpu.scheduler.local import LocalScheduler
 from rafiki_tpu.store import MetaStore, ParamsStore
@@ -193,13 +195,31 @@ class ServicesManager:
         # Same-architecture top-k → ONE worker running a stacked vmapped
         # forward (k models, one XLA program); otherwise the
         # reference-shaped fallback of one worker per trial.
-        from rafiki_tpu.parallel.serving import try_build_stacked
+        # RAFIKI_STACKED_SERVING=0 forces the replicated route (ops
+        # escape hatch + the A/B knob bench_serving drives).
+        from rafiki_tpu.parallel.serving import build_stacked
 
-        stacked = try_build_stacked(best_trials, models, batch_size=batch_size)
+        stacked, route_reason = None, "disabled-by-env"
+        if os.environ.get("RAFIKI_STACKED_SERVING", "1").lower() not in (
+                "0", "false", "no", "off"):
+            stacked, route_reason = build_stacked(best_trials, models,
+                                                  batch_size=batch_size)
         serve_models = [stacked] if stacked is not None else models
+        warmup_s = None
         if stacked is not None:
+            # Pre-warm: the stacked program's XLA compile is paid HERE,
+            # at service creation, never by the first live request.
+            warmup_s = round(stacked.warmup(), 6)
             events.emit("inference_stacked", job_id=inference_job_id,
                         k=len(best_trials))
+        # Route decision is journal-worthy: a post-mortem (and the
+        # twin's calibration extractor) must see WHICH serving shape
+        # this job got and why (docs/serving.md).
+        _journal.record("serving", "route", job_id=inference_job_id,
+                        route=("stacked" if stacked is not None
+                               else "replicated"),
+                        reason=route_reason, k=len(best_trials),
+                        workers=len(serve_models), warmup_s=warmup_s)
 
         for i, model in enumerate(serve_models):
             worker_id = f"{inference_job_id[:8]}-iw{i}"

@@ -338,12 +338,19 @@ class _MpBus:
     so the bus object itself pickles into spawn children (the Manager
     object does not pickle; nested list/Queue proxies would force
     children to create new shared objects through it). Manager ops are
-    IPC round-trips either way, so polling every 5ms instead of
-    blocking Queue.get costs nothing extra at this bus's scale.
+    IPC round-trips either way, so polling instead of blocking
+    Queue.get costs nothing extra at this bus's scale.
     """
 
     _EXPIRED_CAP = 4096  # remembered gathered/timed-out query ids
     REAP_FACTOR = 6.0    # same auto-janitor contract as InProcBus
+    # Poll period for pop/gather waits. This is a FLOOR under every
+    # serving hop that crosses the bus (enq→deq and reply→gather): at
+    # the old 5ms, a k=3 replicated fan-out paid ~2×5ms of pure polling
+    # per query — most of the fanout_cost_s the stacked route exists to
+    # collapse. 1ms keeps the Manager round-trip rate trivial (~1k/s
+    # per idle waiter) while cutting the wire-tax floor 5×.
+    _POLL_S = 0.001
 
     def __init__(self, manager):
         import os
@@ -475,7 +482,7 @@ class _MpBus:
                 return []
             if time.monotonic() >= deadline:
                 return []
-            time.sleep(0.005)
+            time.sleep(self._POLL_S)
 
     def put_prediction(self, query_id, worker_id, prediction, hops=None):
         if _chaos("bus.put_prediction", worker_id) == "drop":
@@ -510,7 +517,7 @@ class _MpBus:
                     limit = min(limit, quorum_at + grace_s)
             if now >= limit:
                 break
-            time.sleep(0.005)
+            time.sleep(self._POLL_S)
         with self._lock:
             preds = self._preds.pop(query_id, ())
             self._expired[query_id] = True

@@ -60,6 +60,13 @@ collective-kill-mid-step       a dp-mesh worker SIGKILLed inside the
 mesh-degrades-single-chip      every mesh-formation attempt fails: the
                                sweep degrades to single-chip mode inside
                                its grace window and still completes
+stacked-worker-loss-fallback   SIGKILL the stacked worker serving a whole
+                               top-k ensemble mid-load: the fallback
+                               supervisor degrades the job to replicated
+                               per-trial workers, the gateway's blackout
+                               re-route carries every admitted request to
+                               an answer, and the loss→fallback story
+                               reconstructs from the journals
 =============================  =============================================
 """
 
@@ -813,3 +820,132 @@ def mesh_degrades_single_chip(tmp, check: CheckFn) -> None:
           _journal_has(recs, "mesh", "degraded"),
           "no mesh/degraded journal record")
     _params_match_serial(check, params, trials)
+
+
+# ---------------------------------------------------------------------------
+# Stacked-route loss scenario (mp bus + spawned stacked worker)
+# ---------------------------------------------------------------------------
+
+
+def _stacked_stub_main(bus, job: str, worker_id: str) -> None:
+    """Spawn target: the stacked worker as its OWN process — the
+    deployment shape of the stacked serving route, where one process
+    holds a job's whole top-k ensemble (docs/serving.md). RAFIKI_CHAOS
+    rides the spawn env, so the inference.forward kill fires HERE, in
+    the child, exactly like a real stacked-worker loss."""
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()
+    from rafiki_tpu import obs
+
+    obs.configure_from_env(role="infer")
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    InferenceWorker(bus, job, worker_id, _ConstModel([0.6, 0.4])).run()
+
+
+@scenario(
+    "stacked-worker-loss-fallback",
+    "SIGKILL the stacked worker that serves a job's WHOLE top-k "
+    "ensemble mid-load: the fallback supervisor must degrade the job "
+    "to replicated per-trial workers, the gateway's blackout re-route "
+    "must carry every admitted request to an answer (zero dropped), "
+    "and the loss->fallback story must reconstruct from the journals.",
+    spec="seed=7;inference.forward:kill:after=1:times=1:match=stacked",
+)
+def stacked_worker_loss_fallback(tmp, check: CheckFn) -> None:
+    import multiprocessing as mp
+    import os
+
+    from rafiki_tpu import telemetry
+    from rafiki_tpu.bus.queues import make_mp_bus
+    from rafiki_tpu.gateway import Gateway, GatewayConfig
+    from rafiki_tpu.obs import journal as journal_mod
+    from rafiki_tpu.predictor import Predictor
+    from rafiki_tpu.worker.fallback import FallbackSupervisor
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    ttl = 1.0
+    ctx = mp.get_context("spawn")
+    manager = ctx.Manager()
+    stop = threading.Event()
+    fallback_threads: List[threading.Thread] = []
+    proc = None
+    sup = None
+    try:
+        bus = make_mp_bus(manager)
+        proc = ctx.Process(target=_stacked_stub_main,
+                           args=(bus, JOB, "stacked-w0"), daemon=True)
+        proc.start()
+        deadline = time.monotonic() + 30
+        while "stacked-w0" not in bus.get_workers(JOB):
+            if time.monotonic() >= deadline:
+                raise RuntimeError("stacked worker never registered")
+            time.sleep(0.02)
+
+        def spawn_fallback():
+            # The replicated degrade: one thread worker per "trial"
+            # (const-model stand-ins — this scenario pins the loss
+            # control flow, not the model math).
+            for i in range(2):
+                w = InferenceWorker(bus, JOB, f"fb{i}",
+                                    _ConstModel([0.6, 0.4]),
+                                    stop_event=stop)
+                th = threading.Thread(target=w.run, daemon=True,
+                                      name=f"chaos-fb{i}")
+                fallback_threads.append(th)
+                th.start()
+
+        sup = FallbackSupervisor(bus, JOB, "stacked-w0", spawn_fallback,
+                                 ttl_s=ttl, poll_s=0.1).start()
+        predictor = Predictor(bus, JOB, timeout_s=10.0, worker_ttl_s=ttl)
+        gw = Gateway(predictor, GatewayConfig(min_replies=1,
+                                              blackout_retries=4))
+        # Request 1 is the fault's after=1 skip: the stacked worker
+        # serves it, seeding the latency EWMA the blackout probes key
+        # off. Request 2's forward IS the kill — its envelope dies with
+        # the worker and only the blackout re-route can save it.
+        outcomes = []
+        for i in range(5):
+            try:
+                outs = gw.predict([[float(i)]], deadline_s=10.0)
+                ok = bool(outs) and not any(
+                    isinstance(o, dict) and "error" in o for o in outs)
+            except Exception:
+                ok = False
+            outcomes.append(ok)
+        check("no_request_dropped", all(outcomes), f"outcomes: {outcomes}")
+        check("stacked_worker_sigkilled",
+              not proc.is_alive() and proc.exitcode == -9,
+              f"alive={proc.is_alive()} exitcode={proc.exitcode}")
+        check("fallback_supervisor_fired", sup.fired.is_set(),
+              "supervisor never saw the lease die")
+        check("blackout_reroute_engaged",
+              telemetry.get_counter("gateway.blackout_retries") >= 1.0,
+              "no gateway.blackout_retries increments")
+        recs = journal_mod.read_dir(journal_mod.journal.log_dir)
+        check("journal_records_fallback",
+              _journal_has(recs, "serving", "fallback"),
+              "no serving/fallback journal record")
+        check("journal_records_blackout_retry",
+              _journal_has(recs, "gateway", "blackout_retry"),
+              "no gateway/blackout_retry journal record")
+        # The kill really fired, and in the CHILD: its chaos/injected
+        # record carries the child pid, which with the parent's records
+        # makes the journals a >=2-pid reconstruction of the loss.
+        injected = [r for r in recs if r.get("kind") == "chaos"
+                    and r.get("name") == "injected"
+                    and r.get("site") == "inference.forward"]
+        check("kill_journaled_from_child",
+              any(r.get("pid") != os.getpid() for r in injected),
+              f"injected records: {injected}")
+    finally:
+        if sup is not None:
+            sup.stop()
+        stop.set()
+        for th in fallback_threads:
+            th.join(timeout=5)
+        if proc is not None and proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=5)
+        manager.shutdown()

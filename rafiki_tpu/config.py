@@ -57,6 +57,11 @@ class Config:
     gateway_breaker_failures: int = 3
     gateway_breaker_cooldown_s: float = 5.0
     max_queries_per_request: int = 1024
+    # Dynamic microbatching (docs/serving.md): coalesce admitted
+    # requests into one bus fan-out. 1 = off (per-request fan-out);
+    # RAFIKI_TPU_GATEWAY_MAX_BATCH / _MAX_BATCH_WAIT_MS override.
+    gateway_max_batch: int = 1
+    gateway_max_batch_wait_ms: float = 5.0
 
     # Compute
     default_dtype: str = "bfloat16"

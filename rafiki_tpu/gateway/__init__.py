@@ -10,10 +10,13 @@ from rafiki_tpu.gateway.gateway import (DEADLINE_RESERVE_FRAC,
                                         LATENCY_EWMA_ALPHA, POLICIES,
                                         RETRY_AFTER_FLOOR_S, Gateway,
                                         GatewayConfig)
+from rafiki_tpu.gateway.microbatch import (FLUSH_REASONS, BatchMember,
+                                           MicroBatcher)
 
 __all__ = [
     "AdmissionController", "ShedError",
     "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
     "Gateway", "GatewayConfig", "POLICIES",
     "DEADLINE_RESERVE_FRAC", "LATENCY_EWMA_ALPHA", "RETRY_AFTER_FLOOR_S",
+    "MicroBatcher", "BatchMember", "FLUSH_REASONS",
 ]

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import threading
@@ -38,6 +39,7 @@ import threading
 from rafiki_tpu import chaos, telemetry
 from rafiki_tpu.gateway.admission import AdmissionController, ShedError
 from rafiki_tpu.gateway.breaker import CircuitBreaker
+from rafiki_tpu.gateway.microbatch import BatchMember, MicroBatcher
 from rafiki_tpu.obs import context as trace_context
 from rafiki_tpu.obs.anatomy import hops as _hops
 from rafiki_tpu.obs.anatomy.timeseries import ServingRollup
@@ -57,6 +59,22 @@ DEADLINE_RESERVE_FRAC = 0.5
 LATENCY_EWMA_ALPHA = 0.2
 #: Minimum Retry-After hint — clients must never busy-spin.
 RETRY_AFTER_FLOOR_S = 0.1
+#: Blackout-retry probe: when a gather returns ZERO replies from EVERY
+#: worker (a dead fan-out set — e.g. a SIGKILLed stacked worker) and
+#: retries remain, the next attempt's gather budget is clamped to
+#: ``max(MIN, FACTOR × latency EWMA)`` instead of the full deadline, so
+#: the request re-routes within its own budget instead of burning it
+#: all waiting on a corpse. Only engages once an EWMA exists — with no
+#: latency model there is no basis to declare blackout early. The 1s
+#: floor keeps the probe a DEATH detector, not a straggler detector:
+#: merely-slow forwards (latency spikes the hedge/breaker machinery
+#: owns) must finish inside the probe, or their retry wait would smear
+#: the tail out of the forward hop and corrupt attribution.
+BLACKOUT_PROBE_FACTOR = 8.0
+BLACKOUT_PROBE_MIN_S = 1.0
+#: Pause between blackout attempts: long enough for a stale lease to
+#: age out of the fan-out set / a fallback worker to register.
+BLACKOUT_BACKOFF_S = 0.2
 
 
 @dataclasses.dataclass
@@ -70,11 +88,23 @@ class GatewayConfig:
     breaker_failures: int = 3       # consecutive misses before opening
     breaker_cooldown_s: float = 5.0
     max_queries_per_request: int = 1024  # HTTP app: 413 above this
+    # Dynamic microbatching (docs/serving.md): >1 coalesces admitted
+    # requests into one bus fan-out of up to max_batch queries, flushed
+    # after at most max_batch_wait_ms (or sooner when a member deadline
+    # demands it). 1 = off: classic per-request fan-out.
+    max_batch: int = 1
+    max_batch_wait_ms: float = 5.0
+    # Bounded re-route attempts when a gather comes back with ZERO
+    # replies from every worker (dead fan-out set — the stacked-worker
+    # loss case). 0 = single attempt, pre-microbatching behaviour.
+    blackout_retries: int = 3
 
     def __post_init__(self):
         if self.policy not in POLICIES:
             raise ValueError(
                 f"unknown routing policy {self.policy!r}; one of {POLICIES}")
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
 
     @classmethod
     def from_config(cls, cfg, **overrides) -> "GatewayConfig":
@@ -89,6 +119,8 @@ class GatewayConfig:
             breaker_failures=cfg.gateway_breaker_failures,
             breaker_cooldown_s=cfg.gateway_breaker_cooldown_s,
             max_queries_per_request=cfg.max_queries_per_request,
+            max_batch=cfg.gateway_max_batch,
+            max_batch_wait_ms=cfg.gateway_max_batch_wait_ms,
         )
         known = {f.name for f in dataclasses.fields(cls)}
         unknown = set(overrides) - known
@@ -121,6 +153,15 @@ class Gateway:
         # every outcome lands in a per-second rollup journaled as
         # serving/ts, with admission/breaker context merged per row.
         self.rollup = ServingRollup(context_fn=self._rollup_context)
+        # Dynamic microbatcher (rafiki_tpu/gateway/microbatch.py): only
+        # constructed when batching is on — max_batch=1 keeps the
+        # classic per-request fan-out with zero new moving parts.
+        self._batcher: Optional[MicroBatcher] = None
+        if self.cfg.max_batch > 1:
+            self._batcher = MicroBatcher(
+                self._execute_batch, self.cfg.max_batch,
+                self.cfg.max_batch_wait_ms / 1000.0,
+                reserve_fn=self._expected_service_s)
         # Latest gateway wins the collector slot: one predictor process
         # serves one job, and tests that build several gateways only
         # ever assert on the live one.
@@ -138,7 +179,10 @@ class Gateway:
                         hedge_grace_s=self.cfg.hedge_grace_s,
                         policy=self.cfg.policy,
                         breaker_failures=self.cfg.breaker_failures,
-                        breaker_cooldown_s=self.cfg.breaker_cooldown_s)
+                        breaker_cooldown_s=self.cfg.breaker_cooldown_s,
+                        max_batch=self.cfg.max_batch,
+                        max_batch_wait_ms=self.cfg.max_batch_wait_ms,
+                        blackout_retries=self.cfg.blackout_retries)
 
     # -- the predict path ----------------------------------------------------
 
@@ -202,6 +246,8 @@ class Gateway:
         # left) while the request holds an inflight slot, which is what
         # drain-under-load scenarios need to stretch.
         chaos.hook("gateway.predict", self.predictor.job_id)
+        if self._batcher is not None:
+            return self._predict_batched(queries, deadline)
         t0 = time.monotonic()
         try:
             # The gateway span is the trace root on the serving path:
@@ -210,12 +256,7 @@ class Gateway:
             with telemetry.span("gateway.predict",
                                 job_id=self.predictor.job_id,
                                 queries=len(queries)):
-                workers, quorum = self._route()
-                report = self.predictor.predict_detailed(
-                    queries, workers=workers,
-                    timeout_s=max(0.0, deadline - time.monotonic()),
-                    min_replies=quorum,
-                    hedge_grace_s=self.cfg.hedge_grace_s)
+                report = self._fanout(queries, deadline)
         finally:
             self.admission.release()
         # lint: disable=RF007 — breaker EWMA input; region is under the span
@@ -238,6 +279,151 @@ class Gateway:
 
         _slo.maybe_tick()
         return report.outputs
+
+    def _predict_batched(self, queries: List[Any],
+                         deadline: float) -> List[Any]:
+        """Microbatched path: ride a shared fan-out, keep per-request
+        observability. The admission slot is held for the whole wait —
+        the inflight budget still bounds concurrency."""
+        member = self._batcher.submit(queries, deadline,
+                                      prefix=_hops.prefix_marks())
+        try:
+            # +2s slack over the deadline: the flusher itself bounds the
+            # fan-out by the member deadlines; this guard only catches a
+            # wedged flusher rather than blocking forever.
+            if not member.wait(max(0.0, deadline - time.monotonic()) + 2.0):
+                raise RuntimeError("microbatch flush timed out")
+        finally:
+            self.admission.release()
+        if member.error is not None:
+            raise member.error
+        report = member.report
+        # lint: disable=RF007 — e2e latency; flush region is under the span
+        elapsed = time.monotonic() - member.enq_t
+        telemetry.observe("gateway.predict_s", elapsed)
+        ok = report.timeouts == 0
+        self.rollup.observe(latency_s=elapsed,
+                            outcome="ok" if ok else "error")
+        # Re-absorb the shared flush chain under THIS request's trace
+        # (prefix + bat + shared worker chain + dec): every member gets
+        # a stitchable waterfall even though the wire saw one envelope.
+        if member.chains:
+            _hops.absorb(uuid.uuid4().hex, member.chains)
+        _journal.record("serving", "request", queries=len(queries),
+                        e2e_s=round(elapsed, 6), ok=ok,
+                        hedged=report.hedged, timeouts=report.timeouts,
+                        batched=True, flush_reason=member.flush_reason)
+        from rafiki_tpu.obs.perf import slo as _slo
+
+        _slo.maybe_tick()
+        return member.outputs
+
+    def _execute_batch(self, members: List[BatchMember],
+                       flush_reason: str) -> None:
+        """Flusher-thread body: one batched fan-out for all members,
+        then scatter per-member output slices and hop chains."""
+        t0 = time.monotonic()
+        bat = _hops.mark("bat")  # shared flush instant for every member
+        flat = [q for m in members for q in m.queries]
+        deadline = min(m.deadline for m in members)
+        telemetry.observe("serving.microbatch.size", float(len(flat)))
+        telemetry.observe("serving.microbatch.fill_ratio",
+                          len(flat) / float(self.cfg.max_batch))
+        if flush_reason == "size":
+            telemetry.inc("serving.microbatch.flush_size")
+        elif flush_reason == "deadline":
+            telemetry.inc("serving.microbatch.flush_deadline")
+        else:
+            telemetry.inc("serving.microbatch.flush_drain")
+        with telemetry.span("gateway.predict",
+                            job_id=self.predictor.job_id,
+                            queries=len(flat), members=len(members)):
+            report = self._fanout(flat, deadline, batched=True)
+        # lint: disable=RF007 — breaker EWMA input; region is under the span
+        elapsed = time.monotonic() - t0
+        self._absorb(report, elapsed)
+        shared = getattr(report, "chains", None)
+        dec = getattr(report, "dec_mark", None)
+        off = 0
+        for m in members:
+            n = len(m.queries)
+            m.outputs = report.outputs[off:off + n]
+            off += n
+            if shared:
+                m.chains = {w: list(m.prefix) + [bat] + list(ch)
+                            + ([dec] if dec else [])
+                            for w, ch in shared.items()}
+            m.flush_reason = flush_reason
+            m.report = report
+            m.elapsed_s = elapsed
+            m.done.set()
+
+    def _fanout(self, queries: List[Any], deadline: float,
+                batched: bool = False):
+        """Route + gather, with bounded blackout re-routes: a gather
+        that ends with ZERO replies from ANY worker (a dead fan-out
+        set, e.g. a SIGKILLed stacked worker) re-routes and retries
+        while retries and deadline budget remain, instead of dropping
+        an admitted request on the floor."""
+        attempts = max(0, self.cfg.blackout_retries)
+        ewma = self._expected_service_s()
+        if not ewma:
+            # No latency model yet (first request / cold gateway): no
+            # basis to cut a gather short, so no probing retries.
+            attempts = 0
+        for attempt in range(attempts + 1):
+            remaining = max(0.0, deadline - time.monotonic())
+            retries_left = attempts - attempt
+            if retries_left:
+                budget = min(remaining, max(BLACKOUT_PROBE_MIN_S,
+                                            BLACKOUT_PROBE_FACTOR * ewma))
+            else:
+                budget = remaining
+            try:
+                workers, quorum = self._route()
+                if batched:
+                    report = self.predictor.predict_batch_detailed(
+                        queries, workers=workers, timeout_s=budget,
+                        min_replies=quorum,
+                        hedge_grace_s=self.cfg.hedge_grace_s)
+                else:
+                    report = self.predictor.predict_detailed(
+                        queries, workers=workers, timeout_s=budget,
+                        min_replies=quorum,
+                        hedge_grace_s=self.cfg.hedge_grace_s)
+            except RuntimeError:
+                # No live workers RIGHT NOW — with retries left (and a
+                # history of successful service) wait out the lease
+                # flap / fallback-worker spawn instead of failing.
+                if not retries_left:
+                    raise
+                report = None
+            if report is not None and report.replies:
+                return report
+            if not retries_left:
+                return report
+            self._note_blackout(report, attempt)
+            time.sleep(min(BLACKOUT_BACKOFF_S,
+                           max(0.0, deadline - time.monotonic())))
+        raise RuntimeError("unreachable")  # pragma: no cover
+
+    def _note_blackout(self, report, attempt: int) -> None:
+        """Feed a blackout attempt into breakers + journal so the
+        re-route is reconstructible post-mortem."""
+        if report is not None:
+            for w in report.workers:
+                br = self._breaker(w)
+                state_before = br.snapshot().get("state")
+                br.record_failure()
+                state_after = br.snapshot().get("state")
+                if state_after != state_before:
+                    _journal.record("gateway", "breaker_transition",
+                                    worker_id=w, from_state=state_before,
+                                    to_state=state_after)
+        telemetry.inc("gateway.blackout_retries")
+        _journal.record("gateway", "blackout_retry", attempt=attempt + 1,
+                        workers=(list(report.workers) if report is not None
+                                 else []))
 
     # -- routing -------------------------------------------------------------
 
@@ -352,6 +538,10 @@ class Gateway:
             self._draining = True
         if not already:
             telemetry.inc("gateway.drains")
+        if self._batcher is not None:
+            # Flush pending microbatch members before closing admission:
+            # they already hold slots, so wait_idle covers them.
+            self._batcher.drain()
         self.admission.close()
         return self.admission.wait_idle(timeout)
 
@@ -380,6 +570,9 @@ class Gateway:
                     "min_replies": self.cfg.min_replies,
                     "max_queries_per_request":
                         self.cfg.max_queries_per_request,
+                    "max_batch": self.cfg.max_batch,
+                    "max_batch_wait_ms": self.cfg.max_batch_wait_ms,
+                    "blackout_retries": self.cfg.blackout_retries,
                 },
                 "breakers": {w: b.snapshot()
                              for w, b in self._breakers.items()},

@@ -43,7 +43,8 @@ SAMPLED_SEGMENTS = ("route", "batch_wait", "forward", "forward_cold",
                     "reply_publish")
 
 #: Segments the engine derives from its own queue/gather dynamics.
-EMERGENT_SEGMENTS = ("admission_wait", "bus_queue", "gather_decide")
+EMERGENT_SEGMENTS = ("admission_wait", "bus_queue", "gather_decide",
+                     "gateway_batch_wait")
 
 #: Per-segment sample cap: above this, evenly spaced order statistics
 #: of the sorted samples are kept — deterministic, shape-preserving.

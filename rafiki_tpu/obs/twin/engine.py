@@ -86,6 +86,13 @@ class TwinConfig:
     #: (bus.pop_queries max_n). Not a gateway knob, so not captured in
     #: gateway/config; override when the fleet runs a non-default cap.
     worker_batch: int = 64
+    #: Gateway dynamic microbatcher (GatewayConfig.max_batch /
+    #: max_batch_wait_ms, in SECONDS here like every sim knob): >1
+    #: models the post-admission batch former — requests accumulate
+    #: until max_batch queries or the deadline-aware wait expires, then
+    #: ONE fan-out serves the whole batch. 1 = per-request fan-out.
+    max_batch: int = 1
+    max_batch_wait_s: float = 0.005
 
     @classmethod
     def from_gateway(cls, g: GatewayConfig, workers: int,
@@ -96,7 +103,9 @@ class TwinConfig:
                     min_replies=g.min_replies,
                     hedge_grace_s=g.hedge_grace_s, policy=g.policy,
                     breaker_failures=g.breaker_failures,
-                    breaker_cooldown_s=g.breaker_cooldown_s)
+                    breaker_cooldown_s=g.breaker_cooldown_s,
+                    max_batch=g.max_batch,
+                    max_batch_wait_s=g.max_batch_wait_ms / 1000.0)
         base.update(overrides)
         return cls(**base)
 
@@ -112,7 +121,10 @@ class TwinConfig:
                     policy=g.get("policy") or "replicate-all",
                     breaker_failures=int(g.get("breaker_failures", 3)),
                     breaker_cooldown_s=float(g.get("breaker_cooldown_s",
-                                                   5.0)))
+                                                   5.0)),
+                    max_batch=int(g.get("max_batch", 1)),
+                    max_batch_wait_s=float(g.get("max_batch_wait_ms",
+                                                 5.0)) / 1000.0)
         base.update(overrides)
         return cls(**base)
 
@@ -131,14 +143,16 @@ class _Worker:
 
 class _Request:
     __slots__ = ("rid", "arrival", "queries", "deadline", "admit_deadline",
-                 "admit_t", "fanset", "quorum", "replies", "decided",
-                 "done_q", "timeouts", "outcome", "done_t", "replied_by")
+                 "admit_t", "join_t", "fanset", "quorum", "replies",
+                 "decided", "done_q", "timeouts", "outcome", "done_t",
+                 "replied_by")
 
     def __init__(self, rid: int, arrival: float, queries: int):
         self.rid = rid
         self.arrival = arrival
         self.queries = queries
         self.admit_t: Optional[float] = None
+        self.join_t: Optional[float] = None   # microbatch former entry
         self.fanset: List[str] = []
         self.quorum = 1
         self.replies: List[List[float]] = []   # per query: reply times
@@ -179,6 +193,13 @@ class _Sim:
         self.waiting: List[_Request] = []
         self.queue_peak = 0
         self.ewma: Optional[float] = None
+        # Microbatch former state (mirrors gateway/microbatch.py when
+        # cfg.max_batch > 1). The gateway's blackout re-route is NOT
+        # modeled — it only engages on total fan-out death, which the
+        # twin surfaces directly as worker_dead + breaker feedback.
+        self.batch_pending: List[_Request] = []
+        self.batch_flushes: Dict[str, int] = {}
+        self.batch_sizes: List[int] = []
         # Metrics.
         self.requests: List[_Request] = []
         self.shed: Dict[str, int] = {}
@@ -293,11 +314,72 @@ class _Sim:
             return
         delay = fault.delay_s if (fault is not None
                                   and fault.mode == "delay") else 0.0
-        self._route(req, self.now + delay + self._sample("route"))
+        if self.cfg.max_batch > 1:
+            self._push(self.now + delay, "batch_join", req)
+        else:
+            self._route(req, self.now + delay + self._sample("route"))
 
     def _release(self) -> None:
         self._track_inflight(-1)
         self._pump()
+
+    # -- gateway microbatch former (mirrors gateway/microbatch.py) -----------
+
+    def _batch_join(self, req: _Request) -> None:
+        if req.outcome is not None:
+            return
+        req.join_t = self.now
+        self.batch_pending.append(req)
+        self._log("batch_join", f"r{req.rid}")
+        if self._batch_size() >= self.cfg.max_batch:
+            self._batch_flush("size")
+        else:
+            self._push(self._batch_flush_at(), "batch_flush_check", None)
+
+    def _batch_size(self) -> int:
+        return sum(r.queries for r in self.batch_pending)
+
+    def _batch_flush_at(self) -> float:
+        """MicroBatcher._flush_at: oldest member's max-wait expiry,
+        capped by every member's deadline minus the service reserve."""
+        reserve = self.ewma or 0.0
+        t = (min(r.join_t for r in self.batch_pending)
+             + self.cfg.max_batch_wait_s)
+        for r in self.batch_pending:
+            t = min(t, r.deadline - reserve)
+        return max(t, self.now)
+
+    def _batch_flush_check(self) -> None:
+        if not self.batch_pending:
+            return   # stale timer: an earlier size flush took everyone
+        if self._batch_size() >= self.cfg.max_batch:
+            self._batch_flush("size")
+        elif self.now >= self._batch_flush_at():
+            self._batch_flush("deadline")
+
+    def _batch_flush(self, reason: str) -> None:
+        """FIFO members up to max_batch queries (always >= 1 member),
+        then ONE fan-out for the whole batch: members share the flush
+        instant and route sample, and their queries land on the workers
+        at the same t_enq — the worker model's micro-batch drain then
+        serves them in one forward, the live stacked worker's
+        single-launch shape."""
+        batch: List[_Request] = []
+        nq = 0
+        while self.batch_pending:
+            r = self.batch_pending[0]
+            if batch and nq + r.queries > self.cfg.max_batch:
+                break
+            batch.append(self.batch_pending.pop(0))
+            nq += r.queries
+        self.batch_flushes[reason] = self.batch_flushes.get(reason, 0) + 1
+        self.batch_sizes.append(nq)
+        self._log("batch_flush", f"n={nq} {reason}")
+        t_enq = self.now + self._sample("route")
+        for r in batch:
+            self._route(r, t_enq)
+        if self.batch_pending:
+            self._push(self._batch_flush_at(), "batch_flush_check", None)
 
     # -- routing + worker service (mirrors Gateway._route) -------------------
 
@@ -458,6 +540,10 @@ class _Sim:
                 self._reply(*payload)
             elif kind == "batch_done":
                 self._batch_done(payload)
+            elif kind == "batch_join":
+                self._batch_join(payload)
+            elif kind == "batch_flush_check":
+                self._batch_flush_check()
             elif kind == "hedge":
                 req, qi = payload
                 self._decide_query(req, qi)
@@ -541,6 +627,13 @@ def simulate(cal: Calibration, cfg: TwinConfig,
         "event_log_sha1": sim._hash.hexdigest(),
         "config": dataclasses.asdict(cfg),
     }
+    if cfg.max_batch > 1:
+        result["microbatch"] = {
+            "flushes": dict(sorted(sim.batch_flushes.items())),
+            "mean_size": (round(sum(sim.batch_sizes)
+                                / len(sim.batch_sizes), 3)
+                          if sim.batch_sizes else None),
+        }
     if record_events:
         result["events"] = [list(e) for e in sim.events]
     return result

@@ -85,5 +85,12 @@ class StackedEnsemble:
         return np.asarray(self._fwd(self._stacked, batch))
 
     def ensemble_proba(self, batch: dict) -> np.ndarray:
-        """Mean over the model axis → (B, C)."""
-        return self.predict_proba(batch).mean(axis=0)
+        """Mean over the model axis → (B, C), computed with the SAME
+        host-side op sequence as the replicated route's ensembler
+        (predictor/ensemble.py: f32 stack-mean, shared renormalize) —
+        the stacked route must bit-match the host ensemble of k serial
+        forwards, which is what the parity test pins."""
+        from rafiki_tpu.predictor.ensemble import renormalize_probs
+
+        probs = self.predict_proba(batch).astype(np.float32)
+        return renormalize_probs(np.mean(probs, axis=0))

@@ -12,7 +12,8 @@ to the reference-shaped one-worker-per-trial path.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence
+import time
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -64,6 +65,19 @@ class StackedTrialModel:
             out.append(probs[:valid])
         return np.concatenate(out) if out else np.zeros((0, 0))
 
+    def warmup(self) -> float:
+        """Pay the stacked program's XLA compile at SERVICE CREATION,
+        not on the first live request: one forward over a zero batch of
+        the compiled shape. Returns the warmup wall seconds (≈ compile
+        time) for the serving/route journal record."""
+        t0 = time.monotonic()
+        input_shape = tuple(self._first._arch[1])
+        x = self._first.preprocess(
+            np.zeros((self.batch_size,) + input_shape,
+                     self._first._input_dtype()))
+        self.predict_proba(x)
+        return time.monotonic() - t0
+
     def destroy(self) -> None:
         self._first.destroy()
         self._ens = None
@@ -76,10 +90,13 @@ def _param_shape_tree(model) -> Any:
                                   model._loop.params)
 
 
-def try_build_stacked(trials: List[dict], models: List[Any],
-                      devices: Optional[Sequence] = None,
-                      batch_size: int = 64) -> Optional[StackedTrialModel]:
-    """Return a stacked adapter when every trial is stackable, else None.
+def build_stacked(trials: List[dict], models: List[Any],
+                  devices: Optional[Sequence] = None,
+                  batch_size: int = 64,
+                  ) -> Tuple[Optional[StackedTrialModel], str]:
+    """Return ``(stacked adapter, reason)`` — the adapter when every
+    trial is stackable (reason ``"stacked"``), else ``(None, why)`` so
+    the route decision is journal-able per job (docs/serving.md).
 
     Stackable = same model template, a JaxModel-style loaded instance
     (module + params pytree), and IDENTICAL param tree shapes — the
@@ -94,16 +111,25 @@ def try_build_stacked(trials: List[dict], models: List[Any],
     is exact for all k.
     """
     if len(models) < 2:
-        return None
+        return None, "single-trial"
     if len({t.get("model_name") for t in trials}) != 1:
-        return None
+        return None, "mixed-templates"
     if not all(hasattr(m, "_module") and getattr(m, "_loop", None) is not None
                for m in models):
-        return None
+        return None, "not-jax-loaded"
     try:
         shapes0 = _param_shape_tree(models[0])
         if any(_param_shape_tree(m) != shapes0 for m in models[1:]):
-            return None
-        return StackedTrialModel(models, devices=devices, batch_size=batch_size)
-    except Exception:
-        return None  # any mismatch → caller falls back to per-trial workers
+            return None, "param-shape-mismatch"
+        return (StackedTrialModel(models, devices=devices,
+                                  batch_size=batch_size), "stacked")
+    except Exception as e:  # any mismatch → caller falls back to per-trial
+        return None, f"build-error: {type(e).__name__}"
+
+
+def try_build_stacked(trials: List[dict], models: List[Any],
+                      devices: Optional[Sequence] = None,
+                      batch_size: int = 64) -> Optional[StackedTrialModel]:
+    """Back-compat wrapper over :func:`build_stacked` (adapter only)."""
+    return build_stacked(trials, models, devices=devices,
+                         batch_size=batch_size)[0]

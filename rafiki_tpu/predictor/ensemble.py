@@ -20,6 +20,19 @@ from typing import Any, List, Optional, Sequence
 import numpy as np
 
 
+def renormalize_probs(mean: np.ndarray) -> np.ndarray:
+    """Re-normalize probability vectors so the ensemble is a
+    distribution. Shared by the host-side mean below AND the stacked
+    device-resident path (rafiki_tpu/parallel/serving.py) — both
+    routes MUST run the identical op sequence or the stacked-vs-serial
+    bit-parity contract breaks."""
+    if mean.ndim >= 1 and np.all(mean >= 0):
+        s = mean.sum(axis=-1, keepdims=True)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            mean = np.where(s > 0, mean / s, mean)
+    return mean
+
+
 def ensemble_predictions(predictions: Sequence[Any]) -> Any:
     """Combine k workers' predictions for ONE query."""
     preds = [p for p in predictions if not (isinstance(p, dict) and "error" in p)]
@@ -35,10 +48,10 @@ def ensemble_predictions(predictions: Sequence[Any]) -> Any:
     if any(a.shape != arrs[0].shape or a.ndim == 0
            or not np.issubdtype(a.dtype, np.floating) for a in arrs):
         return preds[0]
-    mean = np.mean(arrs, axis=0)
-    # Re-normalize probability vectors so the ensemble is a distribution.
-    if mean.ndim >= 1 and np.all(mean >= 0):
-        s = mean.sum(axis=-1, keepdims=True)
-        with np.errstate(invalid="ignore", divide="ignore"):
-            mean = np.where(s > 0, mean / s, mean)
+    # Models emit float32 probabilities; replies arrive as JSON floats
+    # (float64 carrying exact float32 values). Cast back to float32 so
+    # the mean is computed in the SAME dtype the stacked on-device
+    # ensemble uses — the bit-parity contract between the two routes.
+    mean = renormalize_probs(np.mean(
+        np.stack([a.astype(np.float32) for a in arrs]), axis=0))
     return mean.tolist()

@@ -44,6 +44,12 @@ from rafiki_tpu.predictor.ensemble import ensemble_predictions
 #: the SAME constant or capacity predictions silently drift.
 DEFAULT_HEDGE_GRACE_S = 0.25
 
+#: Sentinel key wrapping a combined query list into ONE bus envelope
+#: (the gateway microbatcher's wire format, docs/serving.md). Workers
+#: expand it, run one forward over the flattened batch, and reply with
+#: a list of per-query predictions in order.
+BATCH_KEY = "__rafiki_batch__"
+
 
 @dataclasses.dataclass
 class GatherReport:
@@ -59,6 +65,16 @@ class GatherReport:
 
     def ok(self) -> bool:
         return self.timeouts == 0
+
+
+@dataclasses.dataclass
+class BatchGatherReport(GatherReport):
+    """A :class:`GatherReport` for one microbatched fan-out, plus the
+    raw hop chains so the gateway can stitch per-member waterfalls
+    (each member re-absorbs the shared suffix under its own trace)."""
+
+    chains: Dict[str, List[Any]] = dataclasses.field(default_factory=dict)
+    dec_mark: Optional[List[Any]] = None
 
 
 class Predictor:
@@ -221,6 +237,95 @@ class Predictor:
                             quorum=quorum, replies=replies,
                             timeouts=timeouts, hedged=hedged,
                             elapsed_s=elapsed)
+
+
+    def predict_batch_detailed(self, queries: List[Any],
+                               workers: Optional[List[str]] = None,
+                               timeout_s: Optional[float] = None,
+                               min_replies: Optional[int] = None,
+                               hedge_grace_s: Optional[float] = None,
+                               ) -> BatchGatherReport:
+        """ONE fan-out for a whole microbatch: the combined query list
+        rides a single ``BATCH_KEY`` envelope per worker instead of
+        ``len(queries)`` envelopes each — the wire-tax collapse of the
+        stacked serving route (docs/serving.md). Workers reply with a
+        per-query prediction list; replies ensemble per query index
+        across workers under the same quorum/hedge semantics as
+        :meth:`predict_detailed`.
+
+        Runs under its OWN batch trace (members re-absorb hop chains
+        under their request traces); returns the gathered chains so
+        the gateway can stitch per-member waterfalls."""
+        with trace_context.trace():
+            return self._predict_batch_detailed(
+                queries, workers=workers, timeout_s=timeout_s,
+                min_replies=min_replies, hedge_grace_s=hedge_grace_s)
+
+    def _predict_batch_detailed(self, queries, workers=None, timeout_s=None,
+                                min_replies=None,
+                                hedge_grace_s=None) -> BatchGatherReport:
+        if workers is None:
+            workers = self.live_workers()
+        if not workers:
+            telemetry.inc("predictor.no_live_workers")
+            raise RuntimeError(
+                f"no live inference workers for job {self.job_id}")
+        timeout_s = self.timeout_s if timeout_s is None else timeout_s
+        if min_replies is None:
+            min_replies = self.min_replies
+        quorum = (len(workers) if min_replies is None
+                  else max(1, min(min_replies, len(workers))))
+        grace = self.hedge_grace_s if hedge_grace_s is None else hedge_grace_s
+        n = len(queries)
+        telemetry.inc("predictor.queries", n)
+        telemetry.observe("predictor.fanout_workers", len(workers))
+        qid = uuid.uuid4().hex
+        payload = {BATCH_KEY: list(queries)}
+        for w in workers:
+            self.bus.add_query(w, qid, payload)
+        t_gather = time.monotonic()
+        preds = self.bus.get_predictions(
+            qid, n=len(workers), timeout=timeout_s,
+            min_n=quorum, grace_s=grace)
+        telemetry.observe("predictor.gather_quorum_s",
+                          # lint: disable=RF007 — the delta IS the observation
+                          time.monotonic() - t_gather)
+        dec = _hops.mark("dec")
+        chains = {item[0]: list(item[2])
+                  for item in preds if len(item) > 2 and item[2]}
+        # Only well-formed replies (a per-query list of length n) can
+        # scatter back; anything else is a malformed reply from that
+        # worker and counts as silence.
+        valid = [item for item in preds
+                 if isinstance(item[1], list) and len(item[1]) == n]
+        replies: Dict[str, int] = {item[0]: n for item in valid}
+        hedged = n if valid and len(valid) < len(workers) else 0
+        if valid:
+            timeouts = 0
+            out = [ensemble_predictions([item[1][i] for item in valid])
+                   for i in range(n)]
+        else:
+            timeouts = n
+            out = [{"error": "prediction timeout"}] * n
+        # lint: disable=RF007 — observed into gather_s right below
+        elapsed = time.monotonic() - t_gather
+        telemetry.observe("predictor.gather_s", elapsed)
+        if timeouts:
+            telemetry.inc("predictor.query_timeouts", timeouts)
+        if hedged:
+            telemetry.inc("predictor.hedged_gathers", hedged)
+        _journal.record("gather", "predictor.gather", job_id=self.job_id,
+                        queries=n, workers=list(workers), quorum=quorum,
+                        replies=replies, timeouts=timeouts, hedged=hedged,
+                        batched=True, dur_s=round(elapsed, 6))
+        from rafiki_tpu.obs.perf import slo as _slo
+
+        _slo.maybe_tick()
+        return BatchGatherReport(outputs=out, workers=list(workers),
+                                 quorum=quorum, replies=replies,
+                                 timeouts=timeouts, hedged=hedged,
+                                 elapsed_s=elapsed, chains=chains,
+                                 dec_mark=dec)
 
 
 def default_quorum(k: int) -> int:

@@ -23,6 +23,7 @@ from rafiki_tpu.model.base import BaseModel
 from rafiki_tpu.obs import context as trace_context
 from rafiki_tpu.obs.anatomy import hops as _hops
 from rafiki_tpu.obs.journal import journal as _journal
+from rafiki_tpu.predictor.predictor import BATCH_KEY
 
 
 class InferenceWorker:
@@ -98,6 +99,21 @@ class InferenceWorker:
                 # the forward hop, where tail attribution can see it.
                 fwds = _hops.mark("fwds")
                 was_cold = not self._warm
+                # Microbatch envelopes (predictor.BATCH_KEY) carry a
+                # whole gateway batch as ONE query: expand them into the
+                # flat forward batch, then regroup so a batch envelope
+                # gets a per-query prediction LIST back while plain
+                # envelopes keep their scalar reply shape.
+                flat: List[Any] = []
+                spans = []  # (offset, n, is_batch) per envelope
+                for q in queries:
+                    if isinstance(q, dict) and BATCH_KEY in q:
+                        group = list(q[BATCH_KEY])
+                        spans.append((len(flat), len(group), True))
+                        flat.extend(group)
+                    else:
+                        spans.append((len(flat), 1, False))
+                        flat.append(q)
                 try:
                     # Chaos: a delay here is a latency spike / stuck
                     # replica (the lease stays fresh — the beat thread
@@ -106,12 +122,15 @@ class InferenceWorker:
                     chaos.hook("inference.forward", self.worker_id)
                     with bind, telemetry.span("inference.forward",
                                               worker_id=self.worker_id):
-                        preds = self._predict(queries)
-                    telemetry.inc("inference.queries_served", len(queries))
+                        flat_preds = self._predict(flat)
+                    telemetry.inc("inference.queries_served", len(flat))
                     self._warm = True
                 except Exception as e:  # a bad query batch must not kill the worker
                     telemetry.inc("inference.batch_errors")
-                    preds = [{"error": str(e)}] * len(queries)
+                    flat_preds = [{"error": str(e)}] * len(flat)
+                preds = [list(flat_preds[off:off + n]) if is_batch
+                         else flat_preds[off]
+                         for off, n, is_batch in spans]
                 fwd_end = _hops.mark("fwdc" if was_cold else "fwd")
                 for qid, pred, chain in zip(qids, preds, chains):
                     if chain is None:

@@ -16,6 +16,16 @@ Three modes:
     spawned processes on the multiprocess bus, so the hop waterfall
     crosses >=3 pids (scripts/serving_obs_smoke.py drives this).
 
+``--route`` picks the serving shape (docs/serving.md): ``replicated``
+(default) is the k-replica fan-out — one stub worker per trial, every
+request fanned to all of them; ``stacked`` is the collapsed route —
+ONE worker holds the whole ensemble, the gateway microbatches into it
+(``--max-batch``, default 8 on this route); ``both`` runs the two
+back to back with a telemetry reset in between and emits a combined
+artifact: the stacked headline at top level (that is the route the PR
+ships) plus a ``routes`` block carrying each per-route report, so one
+SERVING_r*.json shows the before/after of the fan-out collapse.
+
 Output: one JSON object on stdout (``schema_version: 2``):
 
   {"schema_version": 2, "qps": ..., "p50_ms": ..., "p99_ms": ...,
@@ -202,13 +212,24 @@ def run_url_mode(args):
     return run_load(post, args.clients, args.requests_per_client, payload)
 
 
-def run_smoke_mode(args):
+def run_smoke_mode(args, route="replicated"):
     from werkzeug.test import Client
 
     from rafiki_tpu.gateway import Gateway, GatewayConfig
     from rafiki_tpu.predictor import Predictor
     from rafiki_tpu.predictor.app import PredictorApp
     from rafiki_tpu.worker.inference import InferenceWorker
+
+    # The stacked route collapses the fan-out: ONE worker stands in for
+    # the whole top-k ensemble (the stub's fixed service time is paid
+    # once per forward either way — exactly the vmap bet), quorum is 1,
+    # and the gateway microbatches into it.
+    stacked = route == "stacked"
+    n_workers = 1 if stacked else args.workers
+    wprefix = "sbw" if stacked else "bw"
+    max_batch = (args.max_batch if args.max_batch is not None
+                 else (8 if stacked else 1))
+    min_replies = 1 if stacked else args.min_replies
 
     stop = threading.Event()
     threads = []
@@ -222,9 +243,9 @@ def run_smoke_mode(args):
         ctx = mp.get_context("spawn")
         manager = ctx.Manager()
         bus = make_mp_bus(manager)
-        for i in range(args.workers):
+        for i in range(n_workers):
             pr = ctx.Process(target=_mp_stub_worker,
-                             args=(bus, f"bw{i}", args.service_ms),
+                             args=(bus, f"{wprefix}{i}", args.service_ms),
                              daemon=True)
             procs.append(pr)
             pr.start()
@@ -232,14 +253,14 @@ def run_smoke_mode(args):
         from rafiki_tpu.bus import InProcBus
 
         bus = InProcBus()
-        for i in range(args.workers):
-            w = InferenceWorker(bus, "bench", f"bw{i}",
+        for i in range(n_workers):
+            w = InferenceWorker(bus, "bench", f"{wprefix}{i}",
                                 _StubModel(args.service_ms), stop_event=stop)
             th = threading.Thread(target=w.run, daemon=True)
             threads.append(th)
             th.start()
     deadline = time.monotonic() + (30 if args.mp else 10)
-    while len(bus.get_workers("bench")) < args.workers:
+    while len(bus.get_workers("bench")) < n_workers:
         if time.monotonic() > deadline:
             raise RuntimeError("bench workers never registered")
         time.sleep(0.005)
@@ -247,7 +268,8 @@ def run_smoke_mode(args):
     predictor = Predictor(bus, "bench", timeout_s=args.deadline_s)
     gateway = Gateway(predictor, GatewayConfig(
         max_inflight=args.max_inflight, max_queue=args.max_queue,
-        min_replies=args.min_replies, hedge_grace_s=0.02))
+        min_replies=min_replies, hedge_grace_s=0.02,
+        max_batch=max_batch, max_batch_wait_ms=args.max_batch_wait_ms))
     wsgi = Client(PredictorApp(gateway))
 
     def post(payload):
@@ -307,6 +329,16 @@ def main(argv=None):
     ap.add_argument("--mp", action="store_true",
                     help="smoke mode with REAL spawned worker processes "
                          "on the mp bus (cross-process waterfalls)")
+    ap.add_argument("--route", choices=("replicated", "stacked", "both"),
+                    default="replicated",
+                    help="serving shape: k-replica fan-out, collapsed "
+                         "stacked worker + gateway microbatching, or "
+                         "both back to back (combined artifact)")
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="gateway microbatch size (default: 1 on the "
+                         "replicated route, 8 on the stacked route)")
+    ap.add_argument("--max-batch-wait-ms", type=float, default=5.0,
+                    help="gateway microbatch deadline-bounded wait")
     ap.add_argument("--pin-trace", default=None,
                     help="send one extra request under this trace id "
                          "after the load (obs waterfall target)")
@@ -330,23 +362,47 @@ def main(argv=None):
 
     obs.configure_from_env(role="gateway")
 
+    def _run_route(route):
+        rep = run_smoke_mode(args, route=route)
+        rep["mode"] = "smoke-mp" if args.mp else "smoke"
+        rep["route"] = route
+        hops, fanout_ms = _hops_block()
+        rep["hops"] = hops
+        rep["ensemble_fanout_cost_ms"] = fanout_ms
+        return rep
+
     if args.url and not args.smoke:
         report = run_url_mode(args)
         report["mode"] = "url"
+        hops, fanout_ms = _hops_block()
+        report["hops"] = hops
+        report["ensemble_fanout_cost_ms"] = fanout_ms
+        unhealthy = [report]
+    elif args.route == "both":
+        from rafiki_tpu import telemetry
+
+        replicated = _run_route("replicated")
+        telemetry.reset()  # per-route hops/fanout, not a blended view
+        stacked = _run_route("stacked")
+        # Stacked headline at top level (the route the PR ships), the
+        # per-route before/after under ``routes`` for the trend gate.
+        report = dict(stacked)
+        report["route"] = "both"
+        report["routes"] = {"replicated": replicated, "stacked": stacked}
+        unhealthy = [replicated, stacked]
     else:
-        report = run_smoke_mode(args)
-        report["mode"] = "smoke-mp" if args.mp else "smoke"
+        report = _run_route(args.route)
+        unhealthy = [report]
 
     report["schema_version"] = SCHEMA_VERSION
-    hops, fanout_ms = _hops_block()
-    report["hops"] = hops
-    report["ensemble_fanout_cost_ms"] = fanout_ms
 
     print(json.dumps(report, indent=2))
 
-    if report["errors"] or not report["ok"]:
-        print(f"bench_serving: unhealthy run ({report['errors']} errors, "
-              f"{report['ok']} ok)", file=sys.stderr)
+    bad = [r for r in unhealthy if r["errors"] or not r["ok"]]
+    if bad:
+        for r in bad:
+            print(f"bench_serving: unhealthy {r.get('route', 'url')} run "
+                  f"({r['errors']} errors, {r['ok']} ok)", file=sys.stderr)
         return 1
     return 0
 

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Chaos CI smoke: three recovery scenarios, end to end (docs/chaos.md).
+"""Chaos CI smoke: four recovery scenarios, end to end (docs/chaos.md).
 
 Runs the fast core of the chaos catalog through the scenario runner:
 
@@ -9,14 +9,19 @@ Runs the fast core of the chaos catalog through the scenario runner:
   * ``straggler-quorum`` — one of three serving replicas stuck 3s per
     forward; quorum gather answers fast, hedging past it;
   * ``drain-under-load`` — gateway drain with injected frontend latency
-    holding inflight slots: flushes, then sheds as ``draining``.
+    holding inflight slots: flushes, then sheds as ``draining``;
+  * ``stacked-worker-loss-fallback`` — the stacked serving route's loss
+    story: SIGKILL the one worker holding a whole top-k ensemble
+    mid-load; the fallback supervisor degrades to replicated workers,
+    the gateway's blackout re-route drops zero admitted requests, and
+    the loss reconstructs from the journals.
 
 (The full catalog, including the kill-mid-pack acceptance scenario,
 runs via ``python -m rafiki_tpu.chaos run all`` and tests/test_chaos.py.)
 
 Output: one JSON object on stdout, e.g.
 
-  {"scenarios": 3, "passed": 3, "injected_faults": 7, "wall_s": ...,
+  {"scenarios": 4, "passed": 4, "injected_faults": 7, "wall_s": ...,
    "reports": [{"name": ..., "passed": true, ...}, ...]}
 
 Exit code: 0 when every scenario's invariants hold; 1 otherwise — this
@@ -32,7 +37,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-SCENARIOS = ["kill-mid-trial-resume", "straggler-quorum", "drain-under-load"]
+SCENARIOS = ["kill-mid-trial-resume", "straggler-quorum", "drain-under-load",
+             "stacked-worker-loss-fallback"]
 
 
 def main() -> int:

@@ -15,7 +15,17 @@ Three phases, each in a fresh subprocess + journal dir
      and the ``serving_forward_p99`` SLO must NOT have breached — the
      no-false-positive control for phase 2.
 
-  2. **Injected mp run** — same stack, chaos plane now delaying
+  2. **Stacked mp run** — ``bench_serving --smoke --mp --route
+     stacked``: ONE spawned worker stands in for the whole top-k
+     ensemble and the gateway microbatches into it (docs/serving.md).
+     The pinned trace must stitch ACROSS the microbatch — >=5 hops
+     including a ``gateway_batch_wait`` segment, >=2 pids, hop sums
+     reconciling within 10% — the microbatch counters must have
+     populated, and the collapsed route's ``ensemble_fanout_cost_ms``
+     must stay under 15ms — a fraction of the tens of ms the
+     replicated k=3 mp fan-out pays in wire tax alone.
+
+  3. **Injected mp run** — same stack, chaos plane now delaying
      ``inference.forward`` by 250ms on ~20% of batches, with a tight
      custom ``serving_forward_p99`` budget (150ms) ticking every
      100ms. ``obs tails`` must attribute the tail to the ``forward``
@@ -29,7 +39,7 @@ Three phases, each in a fresh subprocess + journal dir
      partner chain never mirrors the delay into its gather_decide
      wait, and p=0.2 keeps the delay out of the forward p50.
 
-  3. **Report gate, both polarities** — ``bench_report --serving``
+  4. **Report gate, both polarities** — ``bench_report --serving``
      over synthetic SERVING_r*.json rounds: an improved round must
      exit 0, a regressed round must exit 1. Serving rounds gate the
      trajectory exactly like training rounds.
@@ -162,6 +172,67 @@ def phase_clean(results):
     return ok
 
 
+def phase_stacked(results):
+    log_dir = tempfile.mkdtemp(prefix="serving_smoke_stacked_")
+    pin = PIN + "st"
+    rc, report, err = _bench(log_dir, pin=pin,
+                             extra_args=("--route", "stacked",
+                                         "--requests-per-client", "12"))
+    ph = {"bench_rc": rc, "bench_stderr": err,
+          "route": report.get("route"),
+          "pinned_status": report.get("pinned_status"),
+          "ensemble_fanout_cost_ms": report.get("ensemble_fanout_cost_ms")}
+    ok = (rc == 0 and report.get("schema_version") == 2
+          and report.get("route") == "stacked"
+          and report.get("pinned_status") == 200)
+
+    # The collapsed fan-out is the whole point: one worker, one
+    # envelope per microbatch — the fan-out overhead must sit in
+    # single-digit ms where the replicated k=3 mp run pays tens.
+    fan = report.get("ensemble_fanout_cost_ms")
+    ok = ok and fan is not None and fan < 15.0
+
+    # Microbatching actually engaged: the size/fill/flush counters the
+    # gateway stamps per flush (docs/telemetry.md) rode the journals.
+    hops = report.get("hops") or {}
+    ph["has_batch_wait_hop"] = "gateway_batch_wait" in hops
+    ok = ok and "gateway_batch_wait" in hops
+
+    # The pinned trace must stitch ACROSS the microbatch: member
+    # prefix + shared batch leg + worker leg + decide, >=2 pids, and
+    # a named gateway_batch_wait segment, reconciling within 10%.
+    wf = _obs(log_dir, "waterfall", pin)
+    ph["waterfall_rc"] = wf.returncode
+    queries = []
+    if wf.returncode == 0:
+        try:
+            queries = json.loads(wf.stdout).get("queries", [])
+        except ValueError:
+            pass
+    if queries:
+        segs = {s["segment"] for q in queries
+                for v in q.get("chains", {}).values()
+                for s in v.get("segments", [])}
+        ph["waterfall"] = {
+            "queries": len(queries),
+            "min_hops": min(q.get("n_hops", 0) for q in queries),
+            "pids": sorted({p for q in queries for p in q.get("pids", [])}),
+            "max_reconcile_err": max(q.get("max_reconcile_err", 1.0)
+                                     for q in queries),
+            "segments": sorted(segs),
+        }
+        w = ph["waterfall"]
+        ok = (ok and w["min_hops"] >= 5 and len(w["pids"]) >= 2
+              and "gateway_batch_wait" in segs
+              and w["max_reconcile_err"] <= 0.10)
+    else:
+        ok = False
+
+    ph["ok"] = bool(ok)
+    results["stacked"] = ph
+    return ok
+
+
 def phase_injected(results):
     log_dir = tempfile.mkdtemp(prefix="serving_smoke_chaos_")
     rc, report, err = _bench(
@@ -243,6 +314,7 @@ def phase_report_gate(results):
 def main():
     results = {}
     ok = phase_clean(results)
+    ok = phase_stacked(results) and ok
     ok = phase_injected(results) and ok
     ok = phase_report_gate(results) and ok
     results["ok"] = bool(ok)

@@ -1,0 +1,297 @@
+"""Gateway dynamic microbatching + blackout re-route (docs/serving.md).
+
+The MicroBatcher unit tests pin the flush semantics — size, deadline
+(max-wait AND member-deadline triggers), drain, FIFO slicing, error
+fan-out — with real (short) waits; the gateway tests then drive the
+batched predict path end to end on the in-proc bus, and the blackout
+tests pin the bounded re-route that keeps an admitted request alive
+when its whole fan-out set dies (the stacked-worker loss case,
+chaos scenario ``stacked-worker-loss-fallback``).
+"""
+
+import threading
+import time
+
+import pytest
+
+from rafiki_tpu import telemetry
+from rafiki_tpu.gateway import Gateway, GatewayConfig, MicroBatcher
+from rafiki_tpu.predictor import Predictor
+from rafiki_tpu.predictor.predictor import GatherReport
+
+from tests.test_gateway import _Serving, _SlowConst, _no_errors
+
+
+class _Collector:
+    """Records every flush the batcher executes and answers members."""
+
+    def __init__(self, fail=False):
+        self.flushes = []                 # (n_members, n_queries, reason)
+        self.lock = threading.Lock()
+        self.fail = fail
+
+    def execute(self, members, reason):
+        with self.lock:
+            self.flushes.append(
+                (len(members), sum(len(m.queries) for m in members), reason))
+        if self.fail:
+            raise RuntimeError("injected flush failure")
+        for m in members:
+            m.outputs = [f"out-{q}" for q in m.queries]
+            m.flush_reason = reason
+            m.done.set()
+
+
+def _submit(b, queries, deadline_s=5.0):
+    return b.submit(queries, time.monotonic() + deadline_s, prefix=[])
+
+
+def test_max_batch_one_is_invalid():
+    # 1 means "batching off" and the gateway never constructs a
+    # batcher for it — reaching the class with 1 is a wiring bug.
+    with pytest.raises(ValueError):
+        MicroBatcher(lambda m, r: None, max_batch=1, max_wait_s=0.01)
+    with pytest.raises(ValueError):
+        GatewayConfig(max_batch=0)
+
+
+def test_size_flush_coalesces_to_one_execute():
+    col = _Collector()
+    b = MicroBatcher(col.execute, max_batch=3, max_wait_s=10.0)
+    try:
+        members = [_submit(b, [i]) for i in range(3)]
+        for m in members:
+            assert m.wait(5.0)
+        assert col.flushes == [(3, 3, "size")]
+        assert [m.outputs for m in members] == [
+            ["out-0"], ["out-1"], ["out-2"]]
+        assert all(m.flush_reason == "size" for m in members)
+    finally:
+        b.stop()
+
+
+def test_deadline_flush_bounds_single_request_latency():
+    # The latency floor a lone request pays is max_wait, not "wait for
+    # co-batchers forever": it must flush with reason deadline within
+    # max_wait plus scheduling slack.
+    col = _Collector()
+    b = MicroBatcher(col.execute, max_batch=64, max_wait_s=0.05)
+    try:
+        t0 = time.monotonic()
+        m = _submit(b, ["solo"])
+        assert m.wait(5.0)
+        # lint: disable=RF007 — the delta IS the invariant under test
+        elapsed = time.monotonic() - t0
+        assert m.flush_reason == "deadline"
+        assert elapsed < 0.05 + 0.5, f"flush took {elapsed:.3f}s"
+    finally:
+        b.stop()
+
+
+def test_member_deadline_preempts_max_wait():
+    # A member whose own deadline (minus reserve) lands before the
+    # max-wait expiry pulls the flush forward — waiting must never
+    # burn budget the fan-out itself needs.
+    col = _Collector()
+    b = MicroBatcher(col.execute, max_batch=64, max_wait_s=30.0,
+                     reserve_fn=lambda: 0.05)
+    try:
+        m = _submit(b, ["urgent"], deadline_s=0.2)
+        assert m.wait(5.0), "member deadline never triggered a flush"
+        assert m.flush_reason == "deadline"
+    finally:
+        b.stop()
+
+
+def test_drain_flushes_pending_now():
+    col = _Collector()
+    b = MicroBatcher(col.execute, max_batch=64, max_wait_s=30.0)
+    try:
+        m = _submit(b, ["a", "b"])
+        assert not m.wait(0.05)  # far from max_wait: still pending
+        b.drain()
+        assert m.wait(5.0)
+        assert m.flush_reason == "drain"
+        assert col.flushes == [(1, 2, "drain")]
+    finally:
+        b.stop()
+
+
+def test_fifo_take_respects_max_batch_queries():
+    # max_batch counts QUERIES, not members; a flush takes whole
+    # members FIFO up to the cap, and an oversized member ships alone.
+    col = _Collector()
+    b = MicroBatcher(col.execute, max_batch=4, max_wait_s=10.0)
+    try:
+        big = _submit(b, ["q0", "q1", "q2", "q3", "q4"])  # > max_batch
+        assert big.wait(5.0)
+        assert col.flushes[-1] == (1, 5, "size")
+        ms = [_submit(b, ["a", "b"]), _submit(b, ["c", "d"]),
+              _submit(b, ["e"])]
+        for m in ms[:2]:
+            assert m.wait(5.0)
+        assert col.flushes[-1] == (2, 4, "size")
+        b.drain()
+        assert ms[2].wait(5.0)
+        assert ms[2].flush_reason == "drain"
+    finally:
+        b.stop()
+
+
+def test_execute_exception_fans_to_members():
+    col = _Collector(fail=True)
+    b = MicroBatcher(col.execute, max_batch=2, max_wait_s=0.01)
+    try:
+        m = _submit(b, ["x"])
+        assert m.wait(5.0)
+        assert isinstance(m.error, RuntimeError)
+    finally:
+        b.stop()
+
+
+def test_submit_after_stop_raises():
+    b = MicroBatcher(_Collector().execute, max_batch=2, max_wait_s=0.01)
+    b.stop()
+    with pytest.raises(RuntimeError):
+        _submit(b, ["late"])
+
+
+# -- the batched gateway path ------------------------------------------------
+
+
+def test_gateway_microbatched_end_to_end():
+    """Concurrent requests ride ONE shared fan-out: every request gets
+    its own correct outputs, the microbatch telemetry populates, and
+    the per-request journal semantics (ok, batched) hold."""
+    telemetry.reset()
+    cluster = _Serving([_SlowConst([0.6, 0.4], 0.005)] * 2)
+    try:
+        predictor = Predictor(cluster.bus, cluster.job, timeout_s=5.0)
+        gw = Gateway(predictor, GatewayConfig(
+            min_replies=2, max_batch=4, max_batch_wait_ms=10.0))
+        results = {}
+        lock = threading.Lock()
+
+        def fire(i):
+            out = gw.predict([[float(i)], [float(i) + 0.5]])
+            with lock:
+                results[i] = out
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(6)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=15)
+        assert len(results) == 6
+        for i, out in results.items():
+            assert len(out) == 2 and _no_errors(out), (i, out)
+            assert out[0] == pytest.approx([0.6, 0.4], abs=1e-6)
+        snap = telemetry.snapshot()
+        hists = snap.get("histograms", {})
+        assert hists["serving.microbatch.size"]["count"] >= 1
+        assert hists["serving.microbatch.fill_ratio"]["count"] >= 1
+        counters = snap.get("counters", {})
+        flushes = sum(counters.get(f"serving.microbatch.flush_{r}", 0)
+                      for r in ("size", "deadline", "drain"))
+        assert flushes >= 1
+        # Coalescing actually happened: fewer flushes than requests.
+        assert flushes < 6
+        assert gw.stats()["limits"]["max_batch"] == 4
+        assert gw.stats()["timeouts"] == 0
+    finally:
+        cluster.close()
+
+
+def test_gateway_drain_flushes_microbatch_members():
+    telemetry.reset()
+    cluster = _Serving([_SlowConst([0.6, 0.4])] * 2)
+    try:
+        predictor = Predictor(cluster.bus, cluster.job, timeout_s=5.0)
+        gw = Gateway(predictor, GatewayConfig(
+            min_replies=2, max_batch=64, max_batch_wait_ms=30_000.0))
+        out = {}
+
+        def fire():
+            out["v"] = gw.predict([[1.0]])
+
+        th = threading.Thread(target=fire)
+        th.start()
+        deadline = time.monotonic() + 5
+        while gw._batcher.pending == 0:
+            assert time.monotonic() < deadline, "member never enqueued"
+            time.sleep(0.002)
+        assert gw.drain(timeout=10.0)
+        th.join(timeout=10)
+        assert "v" in out and _no_errors(out["v"])
+    finally:
+        cluster.close()
+
+
+# -- blackout re-route -------------------------------------------------------
+
+
+class _ScriptedPredictor:
+    """Predictor stand-in whose gathers follow a script: each call pops
+    the next reply-count; 0 means a dead fan-out set (zero replies)."""
+
+    job_id = "bljob"
+    timeout_s = 5.0
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    def live_workers(self):
+        return ["w0"]
+
+    def predict_detailed(self, queries, workers=None, timeout_s=None,
+                         min_replies=None, hedge_grace_s=None):
+        self.calls += 1
+        n = self.script.pop(0) if self.script else 1
+        if n:
+            return GatherReport(outputs=[[0.6, 0.4]] * len(queries),
+                                workers=list(workers), quorum=1,
+                                replies={w: len(queries) for w in workers},
+                                timeouts=0, hedged=0, elapsed_s=0.001)
+        return GatherReport(outputs=[{"error": "no predictions"}]
+                            * len(queries),
+                            workers=list(workers), quorum=1,
+                            replies={}, timeouts=len(queries), hedged=0,
+                            elapsed_s=timeout_s or 0.0)
+
+
+def test_blackout_retry_reroutes_dead_fanout():
+    telemetry.reset()
+    pred = _ScriptedPredictor([0, 1])  # first gather dies, re-route wins
+    gw = Gateway(pred, GatewayConfig(min_replies=1, blackout_retries=2))
+    gw._latency_ewma_s = 0.01  # latency model exists: probing is armed
+    before = telemetry.get_counter("gateway.blackout_retries")
+    out = gw.predict([[1.0]], deadline_s=8.0)
+    assert _no_errors(out)
+    assert pred.calls == 2
+    assert telemetry.get_counter("gateway.blackout_retries") == before + 1
+    assert gw.stats()["timeouts"] == 0
+
+
+def test_cold_gateway_does_not_probe():
+    # No latency EWMA -> no basis to cut a gather short: the first
+    # attempt gets the whole budget and a zero-reply gather surfaces
+    # as-is instead of burning the deadline on blind retries.
+    telemetry.reset()
+    pred = _ScriptedPredictor([0, 1])
+    gw = Gateway(pred, GatewayConfig(min_replies=1, blackout_retries=3))
+    out = gw.predict([[1.0]], deadline_s=2.0)
+    assert pred.calls == 1
+    assert isinstance(out[0], dict) and "error" in out[0]
+
+
+def test_blackout_retries_exhausted_returns_timeouts():
+    telemetry.reset()
+    pred = _ScriptedPredictor([0, 0, 0])
+    gw = Gateway(pred, GatewayConfig(min_replies=1, blackout_retries=2))
+    gw._latency_ewma_s = 0.01
+    out = gw.predict([[1.0]], deadline_s=3.0)
+    assert pred.calls == 3  # 2 probes + 1 final full-budget attempt
+    assert isinstance(out[0], dict) and "error" in out[0]
+    assert gw.stats()["timeouts"] == 1

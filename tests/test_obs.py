@@ -243,7 +243,9 @@ def test_obs_cli_trace_and_tail(journaled, capsys):
 #: label escaping.
 _SNAPSHOT = {
     "ts": 1700000000.0,
-    "counters": {"gateway.shed": 3, "bus.queries_added": 12.0},
+    "counters": {"gateway.shed": 3, "bus.queries_added": 12.0,
+                 "serving.microbatch.flush_size": 2,
+                 "gateway.blackout_retries": 1.0},
     "gauges": {"bus.queue_depth": 2, "serving.qps": 18.0},
     "histograms": {
         "predictor.gather_s": {"count": 4, "sum": 0.5, "p50": 0.1,
@@ -252,6 +254,14 @@ _SNAPSHOT = {
                                   "p90": 0.012, "p99": 0.02},
         "serving.fanout_cost_s": {"count": 4, "sum": 0.02, "p50": 0.004,
                                   "p90": 0.006, "p99": 0.008},
+        "serving.microbatch.size": {"count": 2, "sum": 6.0, "p50": 3.0,
+                                    "p90": 4.0, "p99": 4.0},
+        "serving.microbatch.fill_ratio": {"count": 2, "sum": 1.5,
+                                          "p50": 0.75, "p90": 1.0,
+                                          "p99": 1.0},
+        "serving.hop.gateway_batch_wait_s": {"count": 4, "sum": 0.012,
+                                             "p50": 0.003, "p90": 0.005,
+                                             "p99": 0.006},
     },
     "spans": {
         'trial "quoted"': {"count": 2, "total_s": 1.5},

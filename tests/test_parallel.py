@@ -100,3 +100,57 @@ def test_stacked_ensemble_sharded_over_model_axis():
     out = ens.predict_proba({"x": np.zeros((8, 4), np.float32)})
     assert out.shape == (4, 8, 3)
     np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+
+
+def test_build_stacked_fallback_reasons():
+    from rafiki_tpu.parallel.serving import build_stacked
+
+    class _NotJax:
+        pass
+
+    got = build_stacked([{"model_name": "ff"}], [_NotJax()])
+    assert got == (None, "single-trial")
+    got = build_stacked([{"model_name": "ff"}, {"model_name": "cnn"}],
+                        [_NotJax(), _NotJax()])
+    assert got == (None, "mixed-templates")
+    got = build_stacked([{"model_name": "ff"}, {"model_name": "ff"}],
+                        [_NotJax(), _NotJax()])
+    assert got == (None, "not-jax-loaded")
+
+
+def test_stacked_serving_bit_parity_with_serial_ensemble():
+    """The acceptance contract of docs/serving.md: on CPU the stacked
+    route's predictions BIT-MATCH the host-side ensemble of k serial
+    forwards — same float32 mean + renormalize op sequence, so the
+    route choice is invisible to callers."""
+    from rafiki_tpu.models.ff import FeedForward
+    from rafiki_tpu.parallel.serving import build_stacked
+    from rafiki_tpu.predictor.ensemble import ensemble_predictions
+
+    TRAIN = "synthetic://images?classes=5&n=256&w=8&h=8&seed=0"
+    knobs = dict(hidden_layers=1, hidden_units=32, learning_rate=3e-3,
+                 batch_size=64, epochs=1)
+    trials, models = [], []
+    for seed in (0, 1, 2):
+        m = FeedForward(**knobs, seed=0)
+        m._seed = seed
+        m.train(TRAIN)
+        models.append(m)
+        trials.append({"model_name": "ff"})
+
+    rng = np.random.default_rng(7)
+    queries = rng.uniform(0, 1, size=(5, 8, 8, 1)).astype(np.float32).tolist()
+
+    # Serial route FIRST: building the stacked adapter hands the param
+    # copies to the fused program and destroys models[1:].
+    serial = [m.predict(queries) for m in models]
+    host = [ensemble_predictions([s[i] for s in serial])
+            for i in range(len(queries))]
+
+    stacked, reason = build_stacked(trials, models, batch_size=8)
+    assert reason == "stacked" and stacked is not None
+    assert stacked.warmup() > 0.0
+    fused = stacked.predict(queries)
+    assert np.array_equal(np.asarray(fused, dtype=np.float64),
+                          np.asarray(host, dtype=np.float64))
+    stacked.destroy()

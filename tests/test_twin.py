@@ -127,6 +127,28 @@ def test_worker_microbatching_coalesces():
     assert res["p99_ms"] < 3 * 10.0
 
 
+def test_gateway_batch_former_coalesces_and_reports():
+    """With the gateway batch former on (max_batch > 1), same-instant
+    requests ride ONE fan-out: the result grows the microbatch block,
+    flush count stays below request count, and the former is inside
+    the bit-deterministic replay surface. max_batch=1 (batching off)
+    must not grow the block at all."""
+    cal = _open_cal([0.010])
+    cfg = TwinConfig.from_calibration(cal, workers=1, max_batch=8,
+                                      max_batch_wait_s=0.002)
+    res = simulate(cal, cfg, [0.0] * 16, seed=0)
+    assert res["ok"] == 16
+    mb = res["microbatch"]
+    assert sum(mb["flushes"].values()) < 16
+    assert mb["mean_size"] > 1.0
+    assert set(mb["flushes"]) <= {"size", "deadline", "drain"}
+    assert result_fingerprint(res) == result_fingerprint(
+        simulate(cal, cfg, [0.0] * 16, seed=0))
+    off = simulate(cal, TwinConfig.from_calibration(cal, workers=1),
+                   [0.0] * 16, seed=0)
+    assert "microbatch" not in off
+
+
 # -- drift-proofing against the live serving constants ---------------------
 
 
