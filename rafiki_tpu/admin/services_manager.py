@@ -60,6 +60,12 @@ class _InferenceJobHandle:
         self.predictor: Optional[Predictor] = None
         self.gateway: Optional[Gateway] = None
         self.http_server = None  # set when an HTTP frontend is attached
+        # Autoscale attachment (docs/autoscale.md): the serving shape a
+        # scale-up replica must reproduce, and the live controller.
+        self.best_trials: List[dict] = []
+        self.batch_size: int = 0
+        self.stacked_route: bool = False
+        self.autoscaler = None  # AutoscaleController when attached
 
 
 class ServicesManager:
@@ -91,6 +97,22 @@ class ServicesManager:
 
         def run():
             try:
+                from rafiki_tpu.autoscale import controller as _asc
+
+                if _asc.prewarm_enabled():
+                    # Admission-time compile pre-warm (docs/autoscale.md):
+                    # build each model's packed program (and persist the
+                    # XLA artifacts) BEFORE the sweep starts, so a later
+                    # scale-up lands on a warm compile. Best-effort by
+                    # contract — admission never fails on it.
+                    from rafiki_tpu.autoscale import prewarm as _prewarm
+
+                    try:
+                        _prewarm.prewarm_train_job(self.store, job_id)
+                    except Exception:
+                        from rafiki_tpu import telemetry
+
+                        telemetry.inc("autoscale.prewarm_errors")
                 handle.result = scheduler.run_train_job(
                     job_id, n_workers=n_workers, devices=devices,
                     devices_per_trial=devices_per_trial,
@@ -205,6 +227,9 @@ class ServicesManager:
             stacked, route_reason = build_stacked(best_trials, models,
                                                   batch_size=batch_size)
         serve_models = [stacked] if stacked is not None else models
+        handle.best_trials = list(best_trials)
+        handle.batch_size = batch_size
+        handle.stacked_route = stacked is not None
         warmup_s = None
         if stacked is not None:
             # Pre-warm: the stacked program's XLA compile is paid HERE,
@@ -248,6 +273,7 @@ class ServicesManager:
         import time
         t0 = time.monotonic()
         while (len(self.bus.get_workers(inference_job_id)) < len(serve_models)
+               # lint: disable=RF007 — bounded startup wait, not traced
                and time.monotonic() - t0 < deadline):
             time.sleep(0.01)
         predictor_host = None
@@ -306,6 +332,83 @@ class ServicesManager:
             handle = self._inference_jobs.get(inference_job_id)
         return handle.gateway if handle else None
 
+    # -- autoscale (docs/autoscale.md) ---------------------------------------
+
+    def _spawn_scale_replica(self, handle: "_InferenceJobHandle",
+                             inference_job_id: str, index: int):
+        """Build one scale-up replica of the job's serving shape: the
+        stacked ensemble when that route was taken (one worker = whole
+        ensemble; its compile is warm via the stacked warmup + the
+        persistent XLA cache), otherwise the best trial's model. Own
+        stop event — the autoscaler drains replicas one at a time,
+        never through the job-wide event."""
+        if handle.stacked_route:
+            from rafiki_tpu.parallel.serving import build_stacked
+
+            models = [self._load_trial_model(t) for t in handle.best_trials]
+            stacked, _ = build_stacked(handle.best_trials, models,
+                                       batch_size=handle.batch_size)
+            model = stacked if stacked is not None else models[0]
+            if stacked is not None:
+                stacked.warmup()
+        else:
+            model = self._load_trial_model(handle.best_trials[0])
+        worker_id = f"{inference_job_id[:8]}-as{index}"
+        service = self.store.create_service(
+            ServiceType.INFERENCE_WORKER.value, job_id=inference_job_id,
+            worker_index=1000 + index)
+        worker = InferenceWorker(self.bus, inference_job_id, worker_id,
+                                 model, batch_size=handle.batch_size)
+        th = threading.Thread(target=self._run_inference_worker,
+                              args=(worker, service["id"]),
+                              name=worker_id, daemon=True)
+        th.start()
+        handle.workers.append(worker)
+        handle.worker_threads.append(th)
+        return worker_id, worker, th
+
+    def attach_autoscaler(self, inference_job_id: str,
+                          min_workers: Optional[int] = None,
+                          max_workers: Optional[int] = None,
+                          tick_s: Optional[float] = None,
+                          pregate_fn=None, start: bool = True,
+                          **controller_kwargs):
+        """Close the loop over a running inference job: SLO burn +
+        gateway sensors in, worker spawn/drain out, every decision
+        journaled. The baseline fleet is the floor by default — the
+        controller only drains replicas it spawned (they carry their
+        own stop events; the original workers share the job-wide one).
+        Returns the started :class:`AutoscaleController`."""
+        from rafiki_tpu.autoscale import actuators as _actuators
+        from rafiki_tpu.autoscale import controller as _asc
+
+        with self._lock:
+            handle = self._inference_jobs.get(inference_job_id)
+        if handle is None:
+            raise ValueError(f"Inference job {inference_job_id} has no "
+                             "running services in this process")
+        baseline = [(w.worker_id, w, None) for w in handle.workers]
+        lane = _actuators.InferenceWorkerLane(
+            self.bus, inference_job_id,
+            spawn_fn=lambda i: self._spawn_scale_replica(
+                handle, inference_job_id, i),
+            initial=baseline)
+        overrides: Dict[str, Any] = {
+            "min_size": (len(baseline) if min_workers is None
+                         else min_workers)}
+        if max_workers is not None:
+            overrides["max_size"] = max_workers
+        spec = _asc.LaneSpec.from_env("inference", **overrides)
+        controller = _asc.AutoscaleController(
+            lanes=[spec],
+            sensor_fn=lambda: _asc.read_sensors(gateway=handle.gateway),
+            actuators={"inference": lane},
+            tick_s=tick_s, pregate_fn=pregate_fn, **controller_kwargs)
+        handle.autoscaler = controller
+        if start:
+            controller.start()
+        return controller
+
     def attach_http_server(self, inference_job_id: str, server) -> None:
         with self._lock:
             handle = self._inference_jobs.get(inference_job_id)
@@ -320,6 +423,10 @@ class ServicesManager:
             self.store.update_inference_job(inference_job_id,
                                             status=InferenceJobStatus.STOPPED.value)
             return
+        if handle.autoscaler is not None:
+            # The control loop stops FIRST: a controller reacting to
+            # the drain's shed spike would fight the teardown.
+            handle.autoscaler.stop()
         if handle.gateway is not None:
             # Graceful drain BEFORE the workers stop: in-flight requests
             # finish against live workers; new arrivals shed immediately.

@@ -1,0 +1,108 @@
+"""RF012 undamped-actuator.
+
+Elasticity finding (PR 14, docs/autoscale.md): every change to live
+capacity must flow through
+:class:`rafiki_tpu.autoscale.controller.AutoscaleController`, because
+the controller is where hysteresis, per-direction cooldowns, and flap
+damping live. Code that calls the actuator surface directly —
+``lane.scale_to(n)``, the lane's private spawn/drain steps, or an
+``ElasticHandle.request`` delta — bypasses every one of those gates:
+it can flap the fleet at sensor frequency, re-scale against a
+replica whose drain has not reached the freed state, and none of it
+journals an ``autoscale/decision``, so ``obs autoscale`` replays a
+history with holes. The ``autoscale-flap-damping`` chaos scenario
+shows what an undamped actuator does to a square-wave signal: one
+actuation per tick, forever.
+
+Flagged everywhere OUTSIDE ``rafiki_tpu.autoscale`` (the package owns
+its own surface): any call to an attribute named ``scale_to``,
+``_spawn_one`` or ``_drain_one``, and any ``.request(...)`` on a name
+bound to a mesh ``ElasticHandle`` in the same module. Bare
+``.request(...)`` on anything else (HTTP sessions, queues) is NOT
+flagged — the receiver must provably be an elastic handle.
+
+Legitimate direct callers (a teardown path that must zero a lane the
+controller already stopped, a test harness) justify-suppress, stating
+why the damping gates don't apply.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+from rafiki_tpu.analysis.checkers._ast_util import dotted_name
+
+#: The package that owns the actuator surface — exempt.
+SCOPE = "rafiki_tpu.autoscale"
+
+#: Attribute calls that ARE the surface, wherever the receiver came
+#: from: scale_to is the lane contract, the underscored pair are the
+#: lane's internal spawn/drain steps.
+SURFACE_ATTRS = {"scale_to", "_spawn_one", "_drain_one"}
+
+
+def _elastic_handle_names(tree: ast.Module) -> Set[str]:
+    """Names bound to an ``ElasticHandle(...)`` instantiation in this
+    module — the receivers whose ``.request`` is a capacity delta."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        callee = dotted_name(value.func)
+        if not callee or callee.split(".")[-1] != "ElasticHandle":
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+    return names
+
+
+@register
+class UndampedActuator(Checker):
+    id = "RF012"
+    name = "undamped-actuator"
+    severity = "error"
+    rationale = ("a direct call into the scale actuator surface "
+                 "(lane.scale_to / ElasticHandle.request) bypasses the "
+                 "controller's hysteresis, cooldowns and flap damping "
+                 "and journals no autoscale/decision — route capacity "
+                 "changes through AutoscaleController, or "
+                 "justify-suppress a teardown/test path the gates "
+                 "don't apply to")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if ctx.module_name.startswith(SCOPE):
+            return []
+        handles = _elastic_handle_names(ctx.tree)
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr in SURFACE_ATTRS:
+                findings.append(self.finding(
+                    ctx, node,
+                    f"direct `{func.attr}` call on a scale actuator "
+                    f"outside rafiki_tpu.autoscale: this bypasses the "
+                    f"controller's hysteresis/cooldown/flap-damping "
+                    f"gates and journals no autoscale/decision — go "
+                    f"through AutoscaleController (docs/autoscale.md)"))
+            elif (func.attr == "request"
+                  and isinstance(func.value, ast.Name)
+                  and func.value.id in handles):
+                findings.append(self.finding(
+                    ctx, node,
+                    f"`{func.value.id}.request(...)` pushes a chip "
+                    f"delta into a mesh ElasticHandle directly: the "
+                    f"sweep lane's damping gates live in "
+                    f"AutoscaleController, not the handle — scale "
+                    f"through the controller (docs/autoscale.md)"))
+        return findings

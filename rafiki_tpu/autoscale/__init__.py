@@ -1,0 +1,28 @@
+"""Closed-loop elasticity (docs/autoscale.md).
+
+The repo grew every sensor (SLO burn rates, per-second serving rollup,
+effective-trials-per-hour ledger) and every actuator (worker
+spawn/drain, elastic mesh re-packing) before it grew the controller
+connecting them. This package is that controller:
+
+  * :mod:`controller` — the tick-driven reconciler: reads sensors,
+    applies hysteresis / per-direction cooldowns / flap damping, and
+    emits journaled scale decisions for the inference and sweep lanes.
+  * :mod:`actuators` — the actuation surface the controller drives
+    (RF012 keeps ad-hoc callers out so damping can't be bypassed).
+  * :mod:`prewarm` — compiled-pack pre-warming at job admission so a
+    scale-up lands on a warm compile instead of paying the cold one.
+"""
+
+from rafiki_tpu.autoscale.controller import (AutoscaleController, LaneSpec,
+                                             ScaleDecision, inference_pressure,
+                                             read_sensors, sweep_pressure)
+
+__all__ = [
+    "AutoscaleController",
+    "LaneSpec",
+    "ScaleDecision",
+    "inference_pressure",
+    "read_sensors",
+    "sweep_pressure",
+]

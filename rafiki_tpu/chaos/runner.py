@@ -38,7 +38,7 @@ _RECOVERY_SCENARIOS = frozenset({
     "kill-mid-trial-resume", "kill-mid-pack-resume",
     "checkpoint-write-failure", "drain-under-load",
     "mesh-chip-loss-repack", "collective-kill-mid-step",
-    "mesh-degrades-single-chip",
+    "mesh-degrades-single-chip", "load-spike-scale-up",
 })
 
 # Subprocess-killing scenarios must be reconstructible from the

@@ -67,6 +67,16 @@ stacked-worker-loss-fallback   SIGKILL the stacked worker serving a whole
                                re-route carries every admitted request to
                                an answer, and the loss→fallback story
                                reconstructs from the journals
+load-spike-scale-up            the only serving replica pinned 0.3s slow:
+                               the burn engine breaches serving p99, the
+                               autoscale controller scales the lane up, and
+                               the spike recovers — recovery-time-to-SLO
+                               recorded for the bench trend gate
+autoscale-flap-damping         an adversarial square-wave pressure signal
+                               (plus injected sensor faults) on a fake
+                               clock: damping bounds the actuation count
+                               with growing guard intervals while the same
+                               signal undamped thrashes every tick
 =============================  =============================================
 """
 
@@ -949,3 +959,219 @@ def stacked_worker_loss_fallback(tmp, check: CheckFn) -> None:
             proc.terminate()
             proc.join(timeout=5)
         manager.shutdown()
+
+
+@scenario(
+    "load-spike-scale-up",
+    "The closed elasticity loop end to end: the only serving replica "
+    "is pinned slow, the burn engine breaches the serving p99 SLO, "
+    "the autoscale controller scales the inference lane up, and the "
+    "spike recovers — with recovery-time-to-SLO recorded for the "
+    "bench trend gate.",
+    spec="seed=11;inference.forward:delay:delay=0.3:match=w0",
+)
+def load_spike_scale_up(tmp, check: CheckFn) -> None:
+    from rafiki_tpu import chaos, telemetry
+    from rafiki_tpu.autoscale.actuators import InferenceWorkerLane
+    from rafiki_tpu.autoscale.controller import (AutoscaleController,
+                                                 LaneSpec, read_sensors)
+    from rafiki_tpu.bus import InProcBus
+    from rafiki_tpu.gateway import Gateway, GatewayConfig
+    from rafiki_tpu.obs import journal as journal_mod
+    from rafiki_tpu.obs.perf.slo import SloEngine, SloSpec
+    from rafiki_tpu.predictor import Predictor
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    bus = InProcBus()
+    stops: List[threading.Event] = []
+    threads: List[threading.Thread] = []
+
+    def spawn(wid):
+        stop = threading.Event()
+        w = InferenceWorker(bus, JOB, wid, _ConstModel([0.6, 0.4]),
+                            stop_event=stop)
+        th = threading.Thread(target=w.run, daemon=True,
+                              name=f"chaos-as-{wid}")
+        stops.append(stop)
+        threads.append(th)
+        th.start()
+        return w, th
+
+    # One replica, and the fault spec pins exactly it (match=w0): every
+    # forward pays 0.3s, so serving p99 sits ~2x over the 150ms SLO.
+    w0, th0 = spawn("w0")
+    deadline = time.monotonic() + 10
+    while "w0" not in bus.get_workers(JOB):
+        if time.monotonic() >= deadline:
+            raise RuntimeError("w0 never registered")
+        time.sleep(0.005)
+    predictor = Predictor(bus, JOB, timeout_s=8.0)
+    gw = Gateway(predictor, GatewayConfig(min_replies=1, max_queue=32,
+                                          max_inflight=8))
+    # Private burn engine on the rollup's p99 GAUGE: a level source
+    # recovers when the signal falls, unlike the cumulative hist_p99
+    # reservoirs. The tight window makes breach AND recovery resolve
+    # inside the scenario's few seconds of wall.
+    engine = SloEngine([SloSpec("serving_p99_spike", "gauge:serving.p99_ms",
+                                150.0, windows=(0.8,))], tick_s=0.0)
+    lane = InferenceWorkerLane(
+        bus, JOB,
+        spawn_fn=lambda i: (f"as{i}",) + spawn(f"as{i}"),
+        initial=[("w0", w0, th0)])
+    ctl = AutoscaleController(
+        lanes=[LaneSpec("inference", min_size=1, max_size=2,
+                        up_threshold=1.0, down_threshold=0.0,
+                        up_cooldown_s=1.0, down_cooldown_s=60.0)],
+        sensor_fn=lambda: read_sensors(gateway=gw, slo_engine=engine),
+        actuators={"inference": lane},
+        seed=11, tick_s=0.2, tick_global_slo=False)
+    breach_at = None
+    scaled_at = None
+    recovered_at = None
+    try:
+        t_end = time.monotonic() + 12.0
+        while time.monotonic() < t_end:
+            gw.predict([[1.0]])
+            # Force-close the rollup bucket so every loop lap refreshes
+            # the gauge the burn engine samples.
+            gw.rollup.flush()
+            now = time.monotonic()
+            state = engine.tick(now)
+            breaching = state["serving_p99_spike"]["breaching"]
+            if breaching and breach_at is None:
+                breach_at = now
+            decisions = ctl.tick(now)
+            if scaled_at is None and any(d.actuated and d.direction == "up"
+                                         for d in decisions):
+                scaled_at = now
+            if (breach_at is not None and scaled_at is not None
+                    and not breaching):
+                recovered_at = now
+                break
+    finally:
+        for stop in stops:
+            stop.set()
+        for th in threads:
+            th.join(timeout=5)
+    check("slo_breached", breach_at is not None,
+          "serving p99 never breached against a 0.3s-pinned replica")
+    check("scaled_up", scaled_at is not None and lane.size() == 2,
+          f"lane size {lane.size()}, scaled_at={scaled_at}")
+    check("slo_recovered", recovered_at is not None,
+          "burn never cleared after scale-up")
+    if breach_at is not None and recovered_at is not None:
+        recovery_s = recovered_at - breach_at
+        # The smoke reads this gauge right after run_scenario (the
+        # runner resets telemetry BEFORE the body, not after) and
+        # trends it through SCALE_r*.json.
+        telemetry.set_gauge("autoscale.recovery_s", round(recovery_s, 3))
+        check("recovery_within_budget", recovery_s < 8.0,
+              f"recovery took {recovery_s:.2f}s")
+    check("bounded_actuations", ctl.actuation_count("inference") <= 2,
+          f"{ctl.actuation_count('inference')} actuations for one spike")
+    recs = journal_mod.read_dir(journal_mod.journal.log_dir)
+    check("decisions_journaled",
+          any(r.get("kind") == "autoscale" and r.get("name") == "decision"
+              and r.get("actuated") for r in recs),
+          "no actuated autoscale/decision record")
+    plane = chaos.active()
+    fired = [] if plane is None else plane.schedule()
+    check("spike_fault_fired",
+          any(site == "inference.forward" and "w0" in key
+              for site, _mode, _hit, key in fired),
+          f"schedule: {fired}")
+
+
+@scenario(
+    "autoscale-flap-damping",
+    "An adversarially oscillating pressure signal — plus injected "
+    "sensor-plane faults — drives two controllers on a fake clock: "
+    "with damping the actuation count stays bounded and guard "
+    "intervals grow; the identical signal with damping disabled "
+    "thrashes nearly every tick. The contrast is the proof.",
+    spec="seed=13;autoscale.sensor:error:p=0.2",
+)
+def autoscale_flap_damping(tmp, check: CheckFn) -> None:
+    from rafiki_tpu import chaos, telemetry
+    from rafiki_tpu.autoscale.controller import AutoscaleController, LaneSpec
+
+    class _StubLane:
+        def __init__(self):
+            self.n = 2
+            self.calls = 0
+
+        def size(self):
+            return self.n
+
+        def scale_to(self, n):
+            self.n = n
+            self.calls += 1
+
+    TICKS = 120
+    TICK_SPACING = 2.0
+
+    def run(damping: bool):
+        clock = {"t": 0.0}
+        phase = {"i": 0}
+
+        def sensors():
+            # Worst-case square wave: full burn one tick, dead idle the
+            # next. An undamped controller chases it forever.
+            phase["i"] += 1
+            high = phase["i"] % 2 == 1
+            return {"slo_breaching": ["flap"] if high else [],
+                    "slo_burn": 2.0 if high else 0.0,
+                    "queue_frac": 0.0, "shed_rate": 0.0}
+
+        lane = _StubLane()
+        ctl = AutoscaleController(
+            lanes=[LaneSpec("inference", min_size=1, max_size=8,
+                            up_threshold=1.0, down_threshold=0.3,
+                            up_cooldown_s=1.0, down_cooldown_s=1.0)],
+            sensor_fn=sensors,
+            actuators={"inference": lane},
+            clock=lambda: clock["t"],
+            seed=13, tick_s=TICK_SPACING, damping=damping,
+            flap_window_s=600.0, flap_flips=2, flap_backoff=2.0,
+            flap_guard_s=2.0, flap_guard_cap_s=64.0,
+            tick_global_slo=False)
+        act_ts: List[float] = []
+        for _ in range(TICKS):
+            decisions = ctl.tick()
+            if any(d.actuated for d in decisions):
+                act_ts.append(clock["t"])
+            clock["t"] += TICK_SPACING
+        return ctl, lane, act_ts
+
+    damped_ctl, damped_lane, damped_ts = run(damping=True)
+    undamped_ctl, undamped_lane, undamped_ts = run(damping=False)
+    # Polarity 1: the undamped controller really thrashes — near one
+    # actuation per non-faulted tick (this is what damping prevents;
+    # without it the scenario would pass vacuously).
+    check("undamped_flaps", undamped_lane.calls >= TICKS // 2,
+          f"undamped actuated only {undamped_lane.calls}/{TICKS} ticks")
+    # Polarity 2: same signal, damping on -> bounded actuation count.
+    check("damped_bounded", damped_lane.calls <= TICKS // 4,
+          f"damped actuated {damped_lane.calls}/{TICKS} ticks")
+    check("damping_contrast",
+          damped_lane.calls * 3 <= undamped_lane.calls,
+          f"damped {damped_lane.calls} vs undamped {undamped_lane.calls}")
+    # The exponential guard shows up as growing gaps between damped
+    # actuations: the last gap must dwarf the first.
+    gaps = [b - a for a, b in zip(damped_ts, damped_ts[1:])]
+    check("guard_intervals_grow",
+          bool(gaps) and max(gaps) >= 4 * min(gaps),
+          f"damped actuation gaps: {gaps}")
+    check("damped_holds_recorded",
+          telemetry.get_counter("autoscale.damped_holds") >= 1.0,
+          "no damped hold ever recorded")
+    # The injected sensor faults landed, and every faulted tick held:
+    # a controller must never actuate blind.
+    check("sensor_faults_held",
+          telemetry.get_counter("autoscale.sensor_errors") >= 1.0,
+          "sensor-error chaos never fired")
+    plane = chaos.active()
+    fired = [] if plane is None else plane.schedule()
+    check("sensor_fault_fired",
+          any(site == "autoscale.sensor" for site, _mode, _hit, key in fired),
+          f"schedule: {fired}")

@@ -580,3 +580,29 @@ class Gateway:
         out["inflight"] = self.admission.inflight
         out["waiting"] = self.admission.waiting
         return out
+
+    def sensors(self) -> Dict[str, Any]:
+        """Autoscale sensor view (docs/autoscale.md): the admission
+        pressure numbers the controller folds into every
+        ``autoscale/decision`` snapshot — queue depth (absolute and as
+        a fraction of capacity), inflight, cumulative shed rate, and
+        breaker state. Cheap by contract: read on every control tick."""
+        with self._lock:
+            admitted = self._admitted
+            shed = sum(self._shed.values())
+            ewma = self._latency_ewma_s
+            draining = self._draining
+            breakers_open = sum(
+                1 for b in self._breakers.values()
+                if b.snapshot().get("state") != "closed")
+        waiting = self.admission.waiting
+        total = admitted + shed
+        return {
+            "queue_depth": waiting,
+            "queue_frac": waiting / max(1, self.cfg.max_queue),
+            "inflight": self.admission.inflight,
+            "shed_rate": (shed / total) if total else 0.0,
+            "latency_ewma_s": ewma,
+            "breakers_open": breakers_open,
+            "draining": draining,
+        }

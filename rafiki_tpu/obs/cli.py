@@ -45,6 +45,13 @@ Subcommands (all read ``journal-*.jsonl*`` under ``--dir``, default
     lineage [id]   walk one trial across incarnations/chips/packs
                    (evict, backfill, resume, repack); ``--check``
                    exits 1 on orphaned incarnations fleet-wide
+    autoscale      replay the elasticity controller's decision stream
+                   (``autoscale/decision`` + spawn/drain/prewarm):
+                   per-tick lane, direction, pressure, reason and the
+                   sensor snapshot that justified it; ``--check``
+                   exits 1 when actuations flap (direction flips
+                   within ``--window`` exceed ``--flips``) —
+                   docs/autoscale.md
 
 Output is one human line per record by default, ``--json`` for JSONL
 (pipe into jq). Exit code 1 when a requested trace has no records.
@@ -559,6 +566,59 @@ def cmd_serving(log_dir: str, n: int, as_json: bool) -> int:
     return 0
 
 
+def cmd_autoscale(log_dir: str, n: int, as_json: bool, check: bool,
+                  window_s: float, max_flips: int) -> int:
+    """Replay the controller's decision stream; with ``--check``, gate
+    on flap: actuated direction flips per lane inside ``window_s``
+    must stay under ``max_flips`` (the smoke's vacuous-pass polarity
+    runs an undamped controller through here and MUST fail)."""
+    records = [r for r in journal_mod.read_dir(log_dir)
+               if r.get("kind") == "autoscale"]
+    if not records:
+        print(f"no autoscale records under {log_dir} (is a controller "
+              f"running? see docs/autoscale.md)", file=sys.stderr)
+        return 1
+    decisions = [r for r in records if r.get("name") == "decision"]
+    shown = decisions[-n:] if n else decisions
+    if as_json:
+        for r in shown:
+            print(json.dumps(r, default=str))
+    else:
+        for r in shown:
+            flags = "".join((" DAMPED" if r.get("damped") else "",
+                             " VETOED" if r.get("vetoed") else "",
+                             " actuated" if r.get("actuated") else ""))
+            s = r.get("sensors") or {}
+            press = r.get("pressure")
+            print(f"{r.get('lane', '?'):<10} {r.get('direction', '?'):<5}"
+                  f" {r.get('current')}→{r.get('target')}"
+                  f"  p={press if press is None else round(press, 3)}"
+                  f" reason={r.get('reason')}{flags}"
+                  f"  [burn={s.get('slo_burn')} queue={s.get('queue_depth')}"
+                  f" shed={s.get('shed_rate')}"
+                  f" eph={s.get('effective_trials_per_hour')}]")
+    if not check:
+        return 0
+    worst = 0
+    for lane in {r.get("lane") for r in decisions}:
+        acts = [(r.get("tick_ts") or r.get("ts", 0.0), r.get("direction"))
+                for r in decisions
+                if r.get("lane") == lane and r.get("actuated")]
+        flips = [b_ts for (a_ts, a), (b_ts, b) in zip(acts, acts[1:])
+                 if a != b]
+        for i, ts in enumerate(flips):
+            inside = sum(1 for t in flips[:i + 1] if ts - t <= window_s)
+            worst = max(worst, inside)
+    if worst > max_flips:
+        print(f"FLAPPING: {worst} direction flips inside {window_s}s "
+              f"(limit {max_flips}) — an undamped actuator is thrashing "
+              f"capacity (docs/autoscale.md)", file=sys.stderr)
+        return 1
+    print(f"damping ok: worst flip count {worst} within {window_s}s "
+          f"(limit {max_flips})")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     from rafiki_tpu.utils.backend import honor_env_platform
 
@@ -608,6 +668,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp = sub.add_parser("serving",
                         help="continuous serving time-series rows")
     sp.add_argument("-n", type=int, default=32)
+    sp = sub.add_parser("autoscale",
+                        help="elasticity controller decision replay")
+    sp.add_argument("-n", type=int, default=32,
+                    help="show the last N decisions (0 = all)")
+    sp.add_argument("--check", action="store_true",
+                    help="exit 1 when actuations flap (direction flips "
+                         "within --window exceed --flips)")
+    sp.add_argument("--window", type=float, default=60.0,
+                    help="flap detection window seconds (default 60)")
+    sp.add_argument("--flips", type=int, default=4,
+                    help="max direction flips tolerated in the window")
     from rafiki_tpu.obs.twin import cli as twin_cli
 
     # Stdlib-only at import time; the engine loads inside the verbs.
@@ -640,6 +711,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_tails(log_dir, args.json, args.check, args.tolerance)
     if args.cmd == "serving":
         return cmd_serving(log_dir, args.n, args.json)
+    if args.cmd == "autoscale":
+        return cmd_autoscale(log_dir, args.n, args.json, args.check,
+                             args.window, args.flips)
     if args.cmd == "twin":
         return twin_cli.dispatch(args, log_dir, args.json)
     if args.cmd in ("sweep", "lineage"):

@@ -168,6 +168,11 @@ def _make_step_fns(init_fn, apply_fn, loss_fn: LossFn,
         params, opt_state, step_i, rng, hyper = state
         batch = dict(batch)
         poison = batch.pop("_health_poison", None)
+        if poison is not None and getattr(poison, "ndim", 0):
+            # dp-mesh batches carry the poison as a batch-length column
+            # (a rank-0 leaf cannot satisfy the P("dp") batch-sharding
+            # prefix); every element is the same step multiplier.
+            poison = poison[0]
         rng, sub = jax.random.split(rng)
         (loss, metrics), grads = jax.value_and_grad(loss4, has_aux=True)(
             params, batch, sub, hyper)
@@ -637,8 +642,13 @@ class TrainLoop:
                                    self.state, dev_batch)
         while dev_batch is not None:
             if poison is not None and count < n_steps:
-                dev_batch = dict(dev_batch,
-                                 _health_poison=jnp.float32(poison[count]))
+                pz = jnp.float32(poison[count])
+                if self.plan.mesh is not None:
+                    # The dp batch sharding is a rank-≥1 prefix; ship the
+                    # step multiplier as a batch-length column it can
+                    # shard (train_step reads one element back out).
+                    pz = jnp.full((batch_size,), pz, jnp.float32)
+                dev_batch = dict(dev_batch, _health_poison=pz)
             self.state, metrics = self._train_step(self.state, dev_batch)
             # Device scalars appended as-is: the per-step health series
             # syncs to the host ONCE, at the epoch-boundary reduction.

@@ -53,6 +53,48 @@ from rafiki_tpu.worker.train import (InProcAdvisorHandle, PackAborted,
                                      PackedTrialRunner, TrainWorker)
 
 
+class ElasticHandle:
+    """Runtime grow/shrink surface for a live sweep (docs/autoscale.md).
+
+    The autoscale controller's sweep lane requests chip-count deltas
+    here (through ``autoscale.actuators.SweepChipLane`` — RF012 keeps
+    other callers out); the supervisor applies them at its next poll
+    with the machinery that already exists: shrink aborts the
+    highest-index runner at its next epoch boundary and re-packs its
+    rows onto survivors (the chip-loss path, minus the downtime
+    charge), grow spawns a fresh ``_ChipRunner`` into the sweep.
+    Asynchronous by design — ``desired()`` reports live + pending so
+    the controller never double-requests between polls."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._live = 0
+        self.applied: List[Dict[str, Any]] = []
+
+    def request(self, delta: int) -> None:
+        with self._lock:
+            self._pending += int(delta)
+
+    def desired(self) -> int:
+        with self._lock:
+            return max(0, self._live + self._pending)
+
+    def live(self) -> int:
+        with self._lock:
+            return self._live
+
+    def _set_live(self, n: int) -> None:
+        with self._lock:
+            self._live = int(n)
+
+    def _take(self) -> int:
+        """Consume the pending delta (supervisor poll)."""
+        with self._lock:
+            delta, self._pending = self._pending, 0
+            return delta
+
+
 class _ChipRunner:
     """One chip's worker thread + task queue. Tasks are ``("pack",
     rows)`` (train a claimed row set as one pack) or ``("resume",
@@ -73,6 +115,7 @@ class _ChipRunner:
         self.abort = threading.Event()
         self.dead = False        # chip lost: no further tasks run here
         self.reaped = False      # supervisor already re-packed its rows
+        self.scaled_down = False  # voluntary shrink, not a loss
         self.busy = False
         self._errors = errors
         self.thread = threading.Thread(target=self._loop,
@@ -209,8 +252,11 @@ class MeshSweepScheduler:
         trials_per_chip: int = 2,
         advisor_kind: str = "gp",
         stop_event: Optional[threading.Event] = None,
+        elastic: Optional[ElasticHandle] = None,
     ) -> TrainJobResult:
-        """Run a train job as one mesh sweep to budget exhaustion."""
+        """Run a train job as one mesh sweep to budget exhaustion.
+        ``elastic``, when given, lets the autoscale controller grow and
+        shrink the chip count while the sweep runs."""
         t0 = time.monotonic()
         job = self.store.get_train_job(job_id)
         if job is None:
@@ -279,7 +325,7 @@ class MeshSweepScheduler:
             handle = InProcAdvisorHandle(self.advisors, advisor_id)
 
             self._run_sub(job, sub, model_cls, handle, devices, k,
-                          budget, errors, stop_event)
+                          budget, errors, stop_event, elastic=elastic)
 
             trials = self.store.get_trials_of_sub_train_job(sub["id"])
             if stop_event.is_set():
@@ -319,7 +365,8 @@ class MeshSweepScheduler:
 
     def _run_sub(self, job: dict, sub: dict, model_cls: type, handle,
                  devices: List[Any], k: int, budget: Dict[str, Any],
-                 errors: List[str], stop_event: threading.Event) -> None:
+                 errors: List[str], stop_event: threading.Event,
+                 elastic: Optional[ElasticHandle] = None) -> None:
         """One sub-job's sweep: draft, claim, distribute, supervise."""
         job_id = job["id"]
         n_chips = len(devices)
@@ -405,7 +452,40 @@ class MeshSweepScheduler:
         for r in runners:
             r.thread.start()
 
-        self._supervise(job_id, sub["id"], runners, stop_event)
+        chip_seq = [n_chips]  # next chip index for elastic grow
+
+        def spawn_chip() -> _ChipRunner:
+            """Elastic grow: one more chip joins the live sweep. A
+            spare device is used when visible; otherwise the new runner
+            shares a device (thread-level chips — the CPU test
+            topology). The runner starts idle and picks up re-packed
+            resumes like any survivor."""
+            i = chip_seq[0]
+            chip_seq[0] += 1
+            try:
+                devs = local_devices()
+            except Exception:
+                devs = []
+            dev = devs[i % len(devs)] if devs else devices[0]
+            service = self.store.create_service(
+                ServiceType.TRAIN_WORKER.value, job_id=job_id,
+                worker_index=i, devices=[str(dev)])
+            self.store.update_service(service["id"],
+                                      status=ServiceStatus.RUNNING.value)
+            worker = TrainWorker(
+                self.store, self.params_store, sub["id"], model_cls, handle,
+                job["train_dataset_uri"], job["val_dataset_uri"], budget,
+                worker_id=f"{job_id[:8]}-mesh-c{i}", devices=[dev],
+                job_created_at=job["created_at"], service_id=service["id"],
+                stop_event=stop_event, async_persist=False,
+            )
+            r = _ChipRunner(i, dev, worker, k, errors,
+                            budget_max=budget_max)
+            r.thread.start()
+            return r
+
+        self._supervise(job_id, sub["id"], runners, stop_event,
+                        elastic=elastic, spawn_chip=spawn_chip)
 
         for r in runners:
             if r.worker._saver is not None:
@@ -415,14 +495,54 @@ class MeshSweepScheduler:
 
     def _supervise(self, job_id: str, sub_id: str,
                    runners: List[_ChipRunner],
-                   stop_event: threading.Event) -> None:
+                   stop_event: threading.Event,
+                   elastic: Optional[ElasticHandle] = None,
+                   spawn_chip=None) -> None:
         """Poll for chip loss (the ``scheduler.preempt`` chaos probe —
         the same site the process scheduler consults, keyed
-        ``chip<i>``), re-pack dead chips' trials onto survivors, and
-        stop every runner once the sweep is drained."""
+        ``chip<i>``), re-pack dead chips' trials onto survivors, apply
+        elastic grow/shrink requests, and stop every runner once the
+        sweep is drained."""
         lost_at: Dict[int, float] = {}
         rr = 0  # round-robin cursor over survivors for re-packed rows
         while True:
+            if elastic is not None:
+                elastic._set_live(sum(1 for r in runners if r.alive()))
+                delta = elastic._take()
+                if delta > 0 and spawn_chip is not None:
+                    for _ in range(delta):
+                        nr = spawn_chip()
+                        runners.append(nr)
+                        telemetry.inc("mesh.chips_scaled_up")
+                        _journal.record("mesh", "scale_up", job_id=job_id,
+                                        chip=nr.index)
+                        events.emit("mesh_chip_added", job_id=job_id,
+                                    chip=nr.index,
+                                    worker_id=nr.worker.worker_id)
+                        elastic.applied.append(
+                            {"dir": "up", "chip": nr.index})
+                elif delta < 0:
+                    # Shrink newest-first, never below one live chip;
+                    # the abort unwinds the pack at its next epoch
+                    # boundary and the reap below re-packs its rows —
+                    # the chip-loss machinery, minus the downtime
+                    # charge (a voluntary shrink is not an outage).
+                    candidates = sorted(
+                        (r for r in runners
+                         if r.alive() and not r.abort.is_set()),
+                        key=lambda r: -r.index)
+                    for r in candidates[:max(0, min(-delta,
+                                                    len(candidates) - 1))]:
+                        r.scaled_down = True
+                        r.abort.set()
+                        telemetry.inc("mesh.chips_scaled_down")
+                        _journal.record("mesh", "scale_down",
+                                        job_id=job_id, chip=r.index)
+                        events.emit("mesh_chip_removed", job_id=job_id,
+                                    chip=r.index,
+                                    worker_id=r.worker.worker_id)
+                        elastic.applied.append(
+                            {"dir": "down", "chip": r.index})
             for r in runners:
                 if not r.alive():
                     continue
@@ -440,11 +560,18 @@ class MeshSweepScheduler:
                     continue
                 r.reaped = True
                 r.dead = True
-                telemetry.inc("mesh.chips_lost")
-                events.emit("mesh_chip_lost", job_id=job_id,
-                            chip=r.index, worker_id=r.worker.worker_id)
-                _journal.record("mesh", "chip_lost", job_id=job_id,
-                                chip=r.index)
+                if r.scaled_down:
+                    # Voluntary shrink: already journaled as
+                    # mesh/scale_down — not a loss, no downtime charge;
+                    # its rows still re-pack below like any orphan set.
+                    _journal.record("mesh", "scale_down_drained",
+                                    job_id=job_id, chip=r.index)
+                else:
+                    telemetry.inc("mesh.chips_lost")
+                    events.emit("mesh_chip_lost", job_id=job_id,
+                                chip=r.index, worker_id=r.worker.worker_id)
+                    _journal.record("mesh", "chip_lost", job_id=job_id,
+                                    chip=r.index)
                 orphans = [t["id"] for t in
                            self.store.get_trials_of_sub_train_job(sub_id)
                            if t["status"] == TrialStatus.RUNNING.value

@@ -8,7 +8,9 @@ for the one-host-many-chips topology, with the same entity vocabulary
 TrialLog). Swappable for Postgres by reimplementing MetaStore's SQL.
 """
 
+from rafiki_tpu.store.cas import CasParamsStore, make_params_store
 from rafiki_tpu.store.meta import MetaStore
 from rafiki_tpu.store.params import ParamsStore
 
-__all__ = ["MetaStore", "ParamsStore"]
+__all__ = ["CasParamsStore", "MetaStore", "ParamsStore",
+           "make_params_store"]

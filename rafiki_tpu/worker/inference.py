@@ -35,6 +35,12 @@ class InferenceWorker:
         self.model = model
         self.batch_size = batch_size
         self._stop = stop_event or threading.Event()
+        # Drain contract (docs/autoscale.md): set only after the serve
+        # loop exited AND the bus registration is gone — every popped
+        # query has had its prediction published and the lease cannot
+        # route new work here. The autoscale drain path waits on this
+        # before counting the slot freed.
+        self.drained = threading.Event()
         # First successful forward on this worker pays the compile; the
         # hop chain splits it out as forward_cold vs forward so a cold
         # hit cannot masquerade as a warm-path tail.
@@ -143,6 +149,7 @@ class InferenceWorker:
                                                 hops=chain)
         finally:
             self.bus.remove_worker(self.job_id, self.worker_id)
+            self.drained.set()
 
     def _predict(self, queries: List[Any]) -> List[Any]:
         # Always the contract API: predict() owns query semantics

@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""Elasticity CI smoke: the closed autoscale loop must work, and a
+doctored undamped loop must be CAUGHT flapping (docs/autoscale.md).
+
+Three phases, ~5s total:
+
+  1. **Closed loop** — the ``load-spike-scale-up`` chaos scenario: one
+     serving replica pinned 0.3s slow, the burn engine breaches the
+     serving-p99 SLO, the controller scales the inference lane up, the
+     breach clears. Recovery-time-to-SLO and the actuation count land
+     in a SCALE_r artifact for the trend gate.
+  2. **Flap, both polarities** — the ``autoscale-flap-damping``
+     scenario (damped bounded vs undamped thrashing on a fake clock)
+     must pass; then the vacuous-pass rejection: an always-burning
+     sensor driven through a controller with damping DISABLED is
+     journaled and ``obs autoscale --check`` must exit 1 naming the
+     flap, while the identical signal with damping enabled must exit
+     0. A checker that cannot catch the doctored loop would pass
+     vacuously forever.
+  3. **Report gate, both polarities** — ``bench_report --scale`` over
+     synthetic SCALE_r*.json rounds seeded from the real phase-1
+     artifact (improving trend exits 0, a slow-recovery round exits
+     1), and the same both-ways gate for ``--store`` over
+     STORE_r*.json rounds.
+
+Output: one JSON object on stdout. Exit 0 when every assertion holds;
+1 otherwise — this is a CI gate (scripts/check_tier1.sh).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd, timeout=120):
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          env=dict(os.environ), timeout=timeout, cwd=REPO)
+
+
+def phase_closed_loop(results):
+    """Run the acceptance scenario in-process; harvest the recovery
+    gauge the scenario sets (the runner resets telemetry BEFORE the
+    body, not after) into a SCALE round artifact."""
+    from rafiki_tpu import telemetry
+    from rafiki_tpu.chaos.runner import run_scenario
+
+    report = run_scenario("load-spike-scale-up")
+    recovery_s = telemetry.get_gauge("autoscale.recovery_s")
+    actuations = telemetry.get_counter("autoscale.actuations")
+    artifact = {
+        "scale_schema_version": 1,
+        "scenario": report.name,
+        "recovery_s": recovery_s,
+        "actuations": actuations,
+        "decisions": telemetry.get_counter("autoscale.decisions"),
+        "duration_s": round(report.duration_s, 3),
+    }
+    if not report.passed:
+        artifact["error"] = "load-spike-scale-up scenario failed"
+    ph = {
+        "scenario_passed": report.passed,
+        "checks": {c.name: c.ok for c in report.checks},
+        "recovery_s": recovery_s,
+        "actuations": actuations,
+        "ok": (report.passed and recovery_s is not None
+               and recovery_s > 0 and actuations >= 1),
+    }
+    results["closed_loop"] = ph
+    return artifact if ph["ok"] else None
+
+
+def _journaled_flap_run(damping: bool) -> str:
+    """Drive an always-oscillating sensor through a controller on a
+    fake clock, journaled to a fresh dir — the material `obs autoscale
+    --check` gates on. With ``damping=False`` this is the DOCTORED
+    loop the checker must catch."""
+    from rafiki_tpu.autoscale.controller import AutoscaleController, LaneSpec
+    from rafiki_tpu.obs.journal import journal
+
+    log_dir = tempfile.mkdtemp(
+        prefix=f"autoscale_smoke_{'damped' if damping else 'undamped'}_")
+
+    class _StubLane:
+        def __init__(self):
+            self.n = 2
+
+        def size(self):
+            return self.n
+
+        def scale_to(self, n):
+            self.n = n
+
+    clock = {"t": 0.0}
+    phase = {"i": 0}
+
+    def sensors():
+        phase["i"] += 1
+        high = phase["i"] % 2 == 1
+        return {"slo_breaching": ["flap"] if high else [],
+                "slo_burn": 2.0 if high else 0.0,
+                "queue_frac": 0.0, "shed_rate": 0.0}
+
+    journal.configure(log_dir, role="autoscale-smoke")
+    try:
+        ctl = AutoscaleController(
+            lanes=[LaneSpec("inference", min_size=1, max_size=8,
+                            up_threshold=1.0, down_threshold=0.3,
+                            up_cooldown_s=1.0, down_cooldown_s=1.0)],
+            sensor_fn=sensors,
+            actuators={"inference": _StubLane()},
+            clock=lambda: clock["t"],
+            seed=0, tick_s=2.0, damping=damping,
+            flap_window_s=600.0, flap_flips=2, flap_backoff=2.0,
+            flap_guard_s=2.0, flap_guard_cap_s=64.0,
+            tick_global_slo=False)
+        for _ in range(120):
+            ctl.tick()
+            clock["t"] += 2.0
+    finally:
+        journal.close()
+    return log_dir
+
+
+def phase_flap(results):
+    from rafiki_tpu.chaos.runner import run_scenario
+
+    report = run_scenario("autoscale-flap-damping")
+    undamped_dir = _journaled_flap_run(damping=False)
+    damped_dir = _journaled_flap_run(damping=True)
+    caught = _run([sys.executable, "-m", "rafiki_tpu.obs",
+                   "--dir", undamped_dir, "autoscale", "--check"])
+    clean = _run([sys.executable, "-m", "rafiki_tpu.obs",
+                  "--dir", damped_dir, "autoscale", "--check"])
+    ph = {
+        "scenario_passed": report.passed,
+        "checks": {c.name: c.ok for c in report.checks},
+        "undamped_rc": caught.returncode,
+        "undamped_caught": "FLAPPING" in caught.stderr,
+        "damped_rc": clean.returncode,
+        "ok": (report.passed
+               and caught.returncode == 1
+               and "FLAPPING" in caught.stderr
+               and clean.returncode == 0),
+    }
+    if not ph["ok"]:
+        ph["undamped_stderr"] = caught.stderr[-300:]
+        ph["damped_stderr"] = clean.stderr[-300:]
+    results["flap"] = ph
+    return ph["ok"]
+
+
+def phase_report_gate(results, artifact):
+    """bench_report --scale and --store over synthetic rounds, both
+    polarities, seeded from real artifacts so the trend exercises the
+    actual schemas."""
+    td = tempfile.mkdtemp(prefix="scale_rounds_")
+
+    def _round(prefix, n, doc):
+        path = os.path.join(td, f"{prefix}_r{n:02d}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+    improving = [
+        _round("SCALE", 1, dict(artifact, recovery_s=2.0, actuations=2)),
+        _round("SCALE", 2, dict(artifact, recovery_s=1.5, actuations=2)),
+        _round("SCALE", 3, {"scale_schema_version": 1,
+                            "error": "scenario failed"}),
+        _round("SCALE", 4, dict(artifact, recovery_s=1.2, actuations=1)),
+    ]
+    ok_run = _run([sys.executable, "scripts/bench_report.py", "--scale",
+                   *improving])
+    regressed = improving + [
+        _round("SCALE", 5, dict(artifact, recovery_s=9.0, actuations=12))]
+    bad_run = _run([sys.executable, "scripts/bench_report.py", "--scale",
+                    *regressed])
+
+    store_base = {"store_schema_version": 1, "write_txn_per_s": 8000.0,
+                  "dedup_ratio": 0.4, "second_write_frac": 0.13,
+                  "cas_dump_s": 0.004}
+    store_ok = _run([sys.executable, "scripts/bench_report.py", "--store",
+                     _round("STORE", 1, store_base),
+                     _round("STORE", 2, dict(store_base,
+                                             second_write_frac=0.11))])
+    store_bad = _run([sys.executable, "scripts/bench_report.py", "--store",
+                      _round("STORE", 1, store_base),
+                      _round("STORE", 3, dict(store_base,
+                                              second_write_frac=0.45,
+                                              write_txn_per_s=3000.0))])
+    try:
+        ok_doc = json.loads(ok_run.stdout)
+        bad_doc = json.loads(bad_run.stdout)
+        store_bad_doc = json.loads(store_bad.stdout)
+    except ValueError:
+        ok_doc, bad_doc, store_bad_doc = {}, {}, {}
+    error_round_has_data = any(
+        r.get("has_data") for r in ok_doc.get("rounds", [])
+        if str(r.get("round", "")).endswith("r03.json"))
+    ph = {
+        "scale_ok_rc": ok_run.returncode,
+        "scale_ok_verdict": ok_doc.get("verdict"),
+        "scale_regressed_rc": bad_run.returncode,
+        "scale_regressed_metrics": bad_doc.get("regressed"),
+        "error_round_counted": error_round_has_data,
+        "store_ok_rc": store_ok.returncode,
+        "store_regressed_rc": store_bad.returncode,
+        "store_regressed_metrics": store_bad_doc.get("regressed"),
+        "ok": (ok_run.returncode == 0 and ok_doc.get("verdict") == "ok"
+               and bad_run.returncode == 1
+               and "recovery_s" in (bad_doc.get("regressed") or [])
+               and not error_round_has_data
+               and store_ok.returncode == 0
+               and store_bad.returncode == 1
+               and "second_write_frac" in (store_bad_doc.get("regressed")
+                                           or [])),
+    }
+    if not ph["ok"]:
+        ph["scale_ok_stderr"] = ok_run.stderr[-300:]
+        ph["scale_regressed_stderr"] = bad_run.stderr[-300:]
+        ph["store_stderr"] = store_bad.stderr[-300:]
+    results["report_gate"] = ph
+    return ph["ok"]
+
+
+def main(argv=None) -> int:
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()  # pin the platform before the scenario pulls
+    # in jax: off-TPU the child must not hang in backend init (RF001).
+    out = None
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv[:1] == ["--out"]:
+        out = argv[1]
+    results = {}
+    artifact = phase_closed_loop(results)
+    ok = artifact is not None
+    if ok:
+        ok = phase_flap(results) and ok
+    if ok:
+        ok = phase_report_gate(results, artifact) and ok
+    results["ok"] = ok
+    if out and artifact is not None:
+        with open(out, "w") as f:
+            json.dump(artifact, f)
+            f.write("\n")
+    print(json.dumps(results))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,27 @@ SWEEP_METRICS = {
     "advisor_lift": "higher",
 }
 
+#: Elasticity rounds (``--scale``): SCALE_r*.json artifacts from
+#: scripts/autoscale_smoke.py (docs/autoscale.md). Recovery-time-to-SLO
+#: is the loop's headline — how long a load spike burns before the
+#: scale-up lands and the breach clears; actuations is the flap bill
+#: the damping machinery keeps bounded.
+SCALE_METRICS = {
+    "recovery_s": "lower",
+    "actuations": "lower",
+}
+
+#: Params-store rounds (``--store``): STORE_r*.json artifacts from
+#: scripts/measure_store_throughput.py. ``second_write_frac`` is the
+#: CAS dedup acceptance number — the byte fraction a near-identical
+#: second checkpoint actually writes (ISSUE 14 gate: < 0.20).
+STORE_METRICS = {
+    "write_txn_per_s": "higher",
+    "dedup_ratio": "higher",
+    "second_write_frac": "lower",
+    "cas_dump_s": "lower",
+}
+
 #: Metrics where 0 is a legitimate measurement, not "did not run" —
 #: a clean serving round genuinely sheds nothing, a 1-worker round
 #: has zero fan-out cost, a perfectly calibrated twin has zero
@@ -87,7 +108,7 @@ SWEEP_METRICS = {
 #: zero regret. (Throughput-style metrics keep the strict v > 0
 #: rule: their zeros mean a dead backend.)
 ZERO_OK = {"shed_rate", "ensemble_fanout_cost_ms", "p50_err", "p99_err",
-           "regret", "advisor_lift"}
+           "regret", "advisor_lift", "dedup_ratio"}
 
 #: Metrics that are legitimately signed: a GP that *hurt* the sweep
 #: has negative lift, and that is a measurement the trend must carry,
@@ -132,7 +153,9 @@ def load_round(path: str) -> Dict[str, Any]:
         return out
     if ("metric" in doc or "headline" in doc or "qps" in doc
             or "schema_version" in doc or "twin_schema_version" in doc
-            or "sweep_schema_version" in doc):
+            or "sweep_schema_version" in doc
+            or "scale_schema_version" in doc
+            or "store_schema_version" in doc):
         # A raw bench.py / bench_serving.py result saved directly, no
         # driver wrapper.
         out["payload"], out["source"] = doc, "raw"
@@ -193,6 +216,26 @@ def sweep_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     if not isinstance(payload, dict) or payload.get("error"):
         return {}
     return {k: payload.get(k) for k in SWEEP_METRICS
+            if payload.get(k) is not None}
+
+
+def scale_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """The elasticity block: autoscale_smoke artifacts carry the
+    headline keys at top level. A round whose scenario failed stamps
+    ``error`` and yields nothing — a loop that never closed is
+    no-data, not an instant recovery."""
+    if not isinstance(payload, dict) or payload.get("error"):
+        return {}
+    return {k: payload.get(k) for k in SCALE_METRICS
+            if payload.get(k) is not None}
+
+
+def store_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """The params-store block: measure_store_throughput artifacts
+    carry the headline keys at top level."""
+    if not isinstance(payload, dict) or payload.get("error"):
+        return {}
+    return {k: payload.get(k) for k in STORE_METRICS
             if payload.get(k) is not None}
 
 
@@ -282,13 +325,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="trend sweep-anatomy rounds (SWEEP_r*.json "
                         "default glob, trials-per-hour/best-score higher, "
                         "regret lower, advisor_lift signed)")
+    p.add_argument("--scale", action="store_true",
+                   help="trend elasticity rounds (SCALE_r*.json default "
+                        "glob, recovery_s/actuations lower-better)")
+    p.add_argument("--store", action="store_true",
+                   help="trend params-store rounds (STORE_r*.json default "
+                        "glob, txn/s + dedup higher, write frac lower)")
     args = p.parse_args(argv)
 
-    if sum((args.serving, args.twin, args.sweep)) > 1:
+    if sum((args.serving, args.twin, args.sweep, args.scale,
+            args.store)) > 1:
         print(json.dumps(
-            {"error": "--serving, --twin and --sweep are exclusive"}))
+            {"error": "--serving, --twin, --sweep, --scale and --store "
+                      "are exclusive"}))
         return 2
-    if args.sweep:
+    if args.scale:
+        metric_set, headline_fn = SCALE_METRICS, scale_headline_of
+        pattern = "SCALE_r*.json"
+    elif args.store:
+        metric_set, headline_fn = STORE_METRICS, store_headline_of
+        pattern = "STORE_r*.json"
+    elif args.sweep:
         metric_set, headline_fn = SWEEP_METRICS, sweep_headline_of
         pattern = "SWEEP_r*.json"
     elif args.twin:
@@ -320,7 +377,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         "schema_version": REPORT_SCHEMA_VERSION,
         "tolerance": args.tolerance,
         "n_rounds": len(rounds),
-        "mode": ("sweep" if args.sweep
+        "mode": ("scale" if args.scale
+                 else "store" if args.store
+                 else "sweep" if args.sweep
                  else "twin" if args.twin
                  else "serving" if args.serving else "training"),
         "rounds": [{"round": r["round"], "rc": r["rc"],

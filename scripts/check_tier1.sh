@@ -73,6 +73,13 @@ env JAX_PLATFORMS=cpu python scripts/twin_smoke.py > /tmp/_twin_smoke.json \
 # (docs/search_anatomy.md). ~10s.
 env JAX_PLATFORMS=cpu python scripts/sweep_smoke.py > /tmp/_sweep_smoke.json \
   || { echo "TIER1 SWEEP SMOKE FAILED (see /tmp/_sweep_smoke.json)"; exit 1; }
+# Elasticity smoke: the load-spike-scale-up chaos scenario must close
+# the loop (breach -> scale-up -> recovery, time recorded for the
+# SCALE_r* trend), a doctored undamped controller must be CAUGHT
+# flapping by `obs autoscale --check`, and bench_report --scale/--store
+# must gate both ways (docs/autoscale.md). ~5s.
+env JAX_PLATFORMS=cpu python scripts/autoscale_smoke.py > /tmp/_autoscale_smoke.json \
+  || { echo "TIER1 AUTOSCALE SMOKE FAILED (see /tmp/_autoscale_smoke.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

@@ -1,25 +1,41 @@
-"""Measure the sqlite-WAL meta store's ceiling under racing workers.
+"""Measure the store plane: sqlite-WAL meta ceiling + CAS params dedup.
 
 SURVEY.md §7 step 5 prescribed a store "swap-able for Postgres"; this
 deployment keeps sqlite-WAL (one TPU host drives the chips — the
 control plane is host-local) and instead DOCUMENTS its measured
-multi-process ceiling (docs/architecture.md "Meta-store scale"). This
-script produces that number: N worker PROCESSES (sqlite contention is
+multi-process ceiling (docs/architecture.md "Meta-store scale"). Phase
+one produces that number: N worker PROCESSES (sqlite contention is
 cross-process file locking, so threads would flatter it) hammer one
 store with the real trial-loop write mix — atomic budget-claimed trial
 creation, per-epoch log appends, throttled heartbeats, completion
 marks — and the run asserts the budget invariant held (exactly
 max_trials trials) while reporting aggregate write-transactions/sec.
 
-Usage: python scripts/measure_store_throughput.py [n_workers] [trials]
-Prints one JSON line.
+Phase two measures the content-addressed params store (store/cas.py,
+docs/autoscale.md): a synthetic params-like tree is checkpointed, a
+near-identical successor (one layer nudged — the shape of step N vs
+step N+1) is checkpointed again, and the artifact reports how many
+bytes the second write actually streamed. The ISSUE 14 acceptance
+gate is ``second_write_frac < 0.20``: consecutive checkpoints must
+ride chunk-level dedup, not rewrite the tree.
+
+Usage::
+
+    python scripts/measure_store_throughput.py [n_workers] [trials] \
+        [--out STORE_rNN.json]
+
+Prints one machine-readable JSON line (headline keys at top level —
+``bench_report --store`` trends STORE_r*.json artifacts of it); exits
+non-zero when the dedup gate fails.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import multiprocessing as mp
 import os
+import pickle
 import sys
 import tempfile
 import time
@@ -49,9 +65,7 @@ def _worker(db_path: str, sub_id: str, svc_id: str, max_trials: int,
     out_q.put((ops, time.monotonic() - t0))
 
 
-def main() -> None:
-    n_workers = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-    max_trials = int(sys.argv[2]) if len(sys.argv) > 2 else 400
+def _meta_phase(n_workers: int, max_trials: int) -> dict:
     logs_per_trial = 10
     from rafiki_tpu.store import MetaStore
 
@@ -81,7 +95,7 @@ def main() -> None:
     assert len(trials) == max_trials, f"budget violated: {len(trials)}"
     assert all(t["status"] == "COMPLETED" for t in trials)
     total_ops = sum(r[0] for r in results)
-    print(json.dumps({
+    return {
         "n_worker_processes": n_workers,
         "trials": max_trials,
         "logs_per_trial": logs_per_trial,
@@ -89,8 +103,98 @@ def main() -> None:
         "write_txn_per_s": round(total_ops / wall, 1),
         "trials_per_s": round(max_trials / wall, 1),
         "budget_exact": True,
-    }))
+    }
+
+
+def _synthetic_params(seed: int, n_layers: int = 16,
+                      layer_kb: int = 64) -> bytes:
+    """A params-like pickled tree: named float32 layers, the shape a
+    JaxModel.dump_parameters blob has after serialization. Seeded so
+    the first/second checkpoint relationship is reproducible."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n = (layer_kb * 1024) // 4
+    tree = {f"layer_{i}/w": rng.standard_normal(n, dtype=np.float32)
+            for i in range(n_layers)}
+    return pickle.dumps(tree, protocol=4)
+
+
+def _perturbed_params(seed: int, n_layers: int = 16,
+                      layer_kb: int = 64) -> bytes:
+    """The step-N+1 checkpoint: identical tree, ONE layer nudged.
+    Real consecutive checkpoints differ in every layer, but by the
+    pickle framing most chunk boundaries survive — this models the
+    best case the dedup gate certifies the mechanism against."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n = (layer_kb * 1024) // 4
+    tree = {f"layer_{i}/w": rng.standard_normal(n, dtype=np.float32)
+            for i in range(n_layers)}
+    tree["layer_0/w"] = tree["layer_0/w"] + np.float32(1e-3)
+    return pickle.dumps(tree, protocol=4)
+
+
+def _cas_phase(seed: int = 0) -> dict:
+    from rafiki_tpu.store.cas import CasParamsStore
+
+    tmp = tempfile.mkdtemp(prefix="cas-bench-")
+    store = CasParamsStore(tmp)
+    first = _synthetic_params(seed)
+    second = _perturbed_params(seed)
+
+    t0 = time.monotonic()
+    store.save(first, "trial_ckpt_1")
+    first_dump_s = time.monotonic() - t0
+    first_bytes = store.stats()["bytes_written"]
+
+    t0 = time.monotonic()
+    store.save(second, "trial_ckpt_2")
+    cas_dump_s = time.monotonic() - t0
+    second_bytes = store.stats()["bytes_written"] - first_bytes
+
+    # Integrity before any throughput claim: both checkpoints must
+    # round-trip bit-exactly through the chunk store.
+    assert store.load("trial_ckpt_1") == first
+    assert store.load("trial_ckpt_2") == second
+
+    stats = store.stats()
+    return {
+        "cas_blob_bytes": len(first),
+        "cas_chunk_bytes": stats["chunk_bytes"],
+        "cas_first_write_bytes": first_bytes,
+        "cas_second_write_bytes": second_bytes,
+        "second_write_frac": round(second_bytes / max(1, first_bytes), 4),
+        "dedup_ratio": stats["dedup_ratio"],
+        "cas_first_dump_s": round(first_dump_s, 4),
+        "cas_dump_s": round(cas_dump_s, 4),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="scripts/measure_store_throughput.py",
+        description="meta-store ceiling + CAS params dedup, one JSON line")
+    p.add_argument("n_workers", nargs="?", type=int, default=8)
+    p.add_argument("trials", nargs="?", type=int, default=400)
+    p.add_argument("--out", help="also write the artifact here "
+                                 "(STORE_rNN.json round file)")
+    args = p.parse_args(argv)
+
+    doc = {"store_schema_version": 1}
+    doc.update(_meta_phase(args.n_workers, args.trials))
+    doc.update(_cas_phase())
+    # The ISSUE 14 acceptance gate: a near-identical second checkpoint
+    # streams deltas, not the tree.
+    doc["dedup_gate"] = doc["second_write_frac"] < 0.20
+    line = json.dumps(doc)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0 if doc["dedup_gate"] else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

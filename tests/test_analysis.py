@@ -29,7 +29,8 @@ def _analyze_snippet(tmp_path, source, name="snippet.py", select=None):
 
 def test_all_builtin_checkers_registered():
     assert {"RF001", "RF002", "RF003", "RF004", "RF005", "RF006",
-            "RF007", "RF008", "RF009", "RF010", "RF011"} <= set(REGISTRY)
+            "RF007", "RF008", "RF009", "RF010", "RF011",
+            "RF012"} <= set(REGISTRY)
 
 
 # ---------------------------------------------------------------------------
@@ -894,4 +895,90 @@ def test_rf011_justified_suppression_honored(tmp_path):
 def test_rf011_current_tree_is_clean():
     r = analyze_paths([os.path.join(REPO, "rafiki_tpu")], select=["RF011"])
     mine = [f for f in r.unsuppressed if f.checker_id == "RF011"]
+    assert mine == [], [f"{f.path}:{f.line}" for f in mine]
+
+
+# ---------------------------------------------------------------------------
+# RF012 undamped-actuator
+# ---------------------------------------------------------------------------
+
+
+RF012_BAD = """
+    def burst(lane, handle_cls):
+        lane.scale_to(8)
+        handle = handle_cls.ElasticHandle()
+        handle.request(2)
+    """
+
+
+def test_rf012_fires_on_direct_actuator_calls(tmp_path):
+    r = _analyze_snippet(tmp_path, RF012_BAD)
+    found = [f for f in r.unsuppressed if f.checker_id == "RF012"]
+    assert len(found) == 2
+    assert all(f.severity == "error" for f in found)
+    assert "AutoscaleController" in found[0].message
+
+
+def test_rf012_exempts_autoscale_package(tmp_path):
+    # The identical source INSIDE rafiki_tpu/autoscale/ is the surface
+    # itself — the controller must be able to call its own actuators.
+    pkg = tmp_path / "rafiki_tpu" / "autoscale"
+    pkg.mkdir(parents=True)
+    for d in (tmp_path / "rafiki_tpu", pkg):
+        (d / "__init__.py").write_text("")
+    f = pkg / "snippet.py"
+    f.write_text(textwrap.dedent(RF012_BAD))
+    r = analyze_paths([str(f)])
+    assert "RF012" not in _ids(r)
+
+
+def test_rf012_fires_on_lane_internals(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        def sneak(lane):
+            lane._spawn_one()
+            lane._drain_one()
+        """)
+    found = [f for f in r.unsuppressed if f.checker_id == "RF012"]
+    assert len(found) == 2
+
+
+def test_rf012_quiet_on_unrelated_request_calls(tmp_path):
+    # .request on HTTP sessions / arbitrary objects is NOT the
+    # actuator surface: only a name bound to ElasticHandle(...) is.
+    r = _analyze_snippet(tmp_path, """
+        import requests
+
+        def fetch(session):
+            session.request("GET", "/x")
+            return requests.Session().request("GET", "/y")
+        """)
+    assert "RF012" not in _ids(r)
+
+
+def test_rf012_tracks_elastic_handle_binding(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        from rafiki_tpu.scheduler.mesh import ElasticHandle
+
+        def grow():
+            h = ElasticHandle()
+            h.request(1)
+        """)
+    found = [f for f in r.unsuppressed if f.checker_id == "RF012"]
+    assert len(found) == 1
+    assert "ElasticHandle" in found[0].message
+
+
+def test_rf012_justified_suppression_honored(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        def teardown(lane):
+            # lint: disable=RF012 — teardown after controller stop
+            lane.scale_to(0)
+        """)
+    assert "RF012" not in _ids(r)
+
+
+def test_rf012_current_tree_is_clean():
+    r = analyze_paths([os.path.join(REPO, "rafiki_tpu"),
+                       os.path.join(REPO, "scripts")], select=["RF012"])
+    mine = [f for f in r.unsuppressed if f.checker_id == "RF012"]
     assert mine == [], [f"{f.path}:{f.line}" for f in mine]

@@ -131,3 +131,103 @@ def test_params_store_rejects_traversal(tmp_path):
     ps = ParamsStore(tmp_path / "params")
     with pytest.raises(ValueError):
         ps.load("../etc/passwd")
+
+
+# ---------------------------------------------------------------------------
+# Content-addressed params store (docs/autoscale.md): same contract as
+# the plain store, chunk-level dedup underneath.
+# ---------------------------------------------------------------------------
+
+from rafiki_tpu.store import CasParamsStore, make_params_store  # noqa: E402
+
+
+def test_cas_store_round_trip(tmp_path):
+    ps = CasParamsStore(tmp_path / "params")
+    blob = bytes(range(256)) * 1024  # 256 KB, multiple chunks
+    pid = ps.save(blob)
+    assert ps.load(pid) == blob
+    assert ps.exists(pid)
+    assert pid in ps.list()
+    ps.delete(pid)
+    assert not ps.exists(pid)
+
+
+def test_cas_store_reads_plain_format_in_place(tmp_path):
+    """Flipping RAFIKI_PARAMS_CAS on over an existing directory must
+    not strand old checkpoints: the CAS store reads plain files."""
+    plain = ParamsStore(tmp_path / "params")
+    pid = plain.save(b"pre-cas-weights")
+    cas = CasParamsStore(tmp_path / "params")
+    assert cas.load(pid) == b"pre-cas-weights"
+    # and the plain path still integrity-checks
+    path = cas._path(pid)
+    path.write_bytes(path.read_bytes()[:-1] + b"X")
+    with pytest.raises(IOError):
+        cas.load(pid)
+
+
+def test_cas_second_write_dedups(tmp_path):
+    """The acceptance number: a second checkpoint of a near-identical
+    tree writes < 20% of the first's bytes."""
+    import hashlib as _hashlib
+
+    ps = CasParamsStore(tmp_path / "params")
+    # 1 MB of DISTINCT chunk content (a repeating pattern would dedup
+    # against itself on the first write and prove nothing).
+    base = bytearray(b"".join(
+        _hashlib.sha256(str(i).encode()).digest() for i in range(32768)))
+    first = bytes(base)
+    base[100] ^= 0xFF  # one flipped byte = one dirty chunk
+    second = bytes(base)
+    ps.save(first)
+    w0 = ps.stats()["bytes_written"]
+    pid2 = ps.save(second)
+    w1 = ps.stats()["bytes_written"] - w0
+    assert w1 < 0.2 * w0, f"second write {w1}B vs first {w0}B"
+    assert ps.load(pid2) == second
+    assert ps.stats()["dedup_ratio"] > 0.4
+
+
+def test_cas_identical_write_is_all_hits(tmp_path):
+    ps = CasParamsStore(tmp_path / "params")
+    blob = b"z" * (200 * 1024)
+    p1 = ps.save(blob)
+    w0 = ps.stats()["bytes_written"]
+    p2 = ps.save(blob)
+    # only the (tiny) manifest is new
+    assert ps.stats()["bytes_written"] - w0 < 2048
+    assert p1 != p2 and ps.load(p1) == ps.load(p2) == blob
+
+
+def test_cas_missing_and_corrupt_chunks_fail_integrity(tmp_path):
+    ps = CasParamsStore(tmp_path / "params")
+    blob = bytes(range(256)) * 1024
+    pid = ps.save(blob)
+    chunks = sorted(p for p in (tmp_path / "params" / "chunks").iterdir()
+                    if p.suffix != ".tmp")
+    victim = chunks[0]
+    saved = victim.read_bytes()
+    victim.write_bytes(saved[:-1] + b"X")
+    with pytest.raises(IOError, match="corrupt"):
+        ps.load(pid)
+    victim.unlink()
+    with pytest.raises(IOError, match="missing chunk"):
+        ps.load(pid)
+
+
+def test_cas_gc_keeps_live_chunks(tmp_path):
+    ps = CasParamsStore(tmp_path / "params")
+    keep = ps.save(b"a" * (128 * 1024))
+    drop = ps.save(b"b" * (128 * 1024))
+    ps.delete(drop)
+    removed = ps.gc()
+    assert removed >= 1
+    assert ps.load(keep) == b"a" * (128 * 1024)  # survivors intact
+    assert ps.gc() == 0  # idempotent
+
+
+def test_make_params_store_honours_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("RAFIKI_PARAMS_CAS", raising=False)
+    assert type(make_params_store(tmp_path / "p1")) is ParamsStore
+    monkeypatch.setenv("RAFIKI_PARAMS_CAS", "1")
+    assert isinstance(make_params_store(tmp_path / "p2"), CasParamsStore)
