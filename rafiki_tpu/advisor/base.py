@@ -191,10 +191,14 @@ class BaseAdvisor:
         audit.record_feedback(self, score, knobs)
 
 
-def make_advisor(knob_config: KnobConfig, kind: str = "gp", seed: int = 0) -> BaseAdvisor:
+def make_advisor(knob_config: KnobConfig, kind: str = "gp", seed: int = 0,
+                 **engine_kwargs) -> BaseAdvisor:
     """Factory: 'gp' (default, reference's BTB-GP/skopt analog), 'tpe'
     (Parzen-estimator engine — cheap past hundreds of observations),
-    or 'random'."""
+    or 'random'. ``engine_kwargs`` pass through to the chosen engine's
+    constructor (e.g. ``n_initial`` for GP) — the caller owns matching
+    them to the kind; ``resume_sweep`` replays them from the sweep WAL
+    so a rehydrated advisor is built exactly like the original."""
     from rafiki_tpu.advisor.gp import GpAdvisor
     from rafiki_tpu.advisor.random_advisor import RandomAdvisor
     from rafiki_tpu.advisor.tpe import TpeAdvisor
@@ -204,4 +208,4 @@ def make_advisor(knob_config: KnobConfig, kind: str = "gp", seed: int = 0) -> Ba
              "tpe": TpeAdvisor, "hyperopt": TpeAdvisor}
     if kind not in kinds:
         raise ValueError(f"Unknown advisor kind {kind!r}; choose from {sorted(kinds)}")
-    return kinds[kind](knob_config, seed=seed)
+    return kinds[kind](knob_config, seed=seed, **engine_kwargs)

@@ -129,6 +129,17 @@ class GpAdvisor(BaseAdvisor):
         t0 = time.monotonic()
         X = np.vstack(self._X)
         y = np.asarray(self._y)
+        # Canonical row order before fitting: the GP posterior is
+        # mathematically permutation-invariant but the Cholesky is not
+        # bit-level so — and crash-resume rehydration replays the same
+        # observation SET in a different arrival order. Sorting makes
+        # "same observations + same rng position" imply byte-identical
+        # proposals, which is the advisor-rehydration equivalence
+        # contract docs/recovery.md tests pin.
+        if len(y) > 1:
+            order = np.lexsort(np.concatenate([X, y[:, None]], axis=1).T[::-1])
+            X = X[order]
+            y = y[order]
         b = self.space.bounds()
         span = np.maximum(b[:, 1] - b[:, 0], 1e-12)
         kernel = (ConstantKernel(1.0) * Matern(length_scale=0.25 * span, nu=2.5)

@@ -23,13 +23,15 @@ class AdvisorService:
         self._lock = threading.Lock()
 
     def create_advisor(self, knob_config: KnobConfig | str, kind: str = "gp",
-                       seed: int = 0, advisor_id: Optional[str] = None) -> str:
+                       seed: int = 0, advisor_id: Optional[str] = None,
+                       engine_kwargs: Optional[dict] = None) -> str:
         if isinstance(knob_config, str):
             knob_config = deserialize_knob_config(knob_config)
         aid = advisor_id or uuid.uuid4().hex
         with self._lock:
             if aid not in self._advisors:
-                adv = make_advisor(knob_config, kind=kind, seed=seed)
+                adv = make_advisor(knob_config, kind=kind, seed=seed,
+                                   **(engine_kwargs or {}))
                 # Stamp the registry id so every advisor/* journal
                 # record this engine emits is filterable per sweep
                 # (obs sweep <job> — docs/search_anatomy.md).

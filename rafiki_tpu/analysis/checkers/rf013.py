@@ -1,0 +1,126 @@
+"""RF013 undurable-decision.
+
+Crash-safety contract (PR 15, docs/recovery.md): the sweep control
+plane is only resumable because every budget-consuming or
+work-assigning mutation it makes is preceded by a durable, fsynced
+WAL ``intent()`` record — ``resume_sweep`` reconciles the WAL against
+the MetaStore to prove "every slot claimed exactly once" before a
+fresh process adopts a dead supervisor's job. A scheduler code path
+that claims a trial row (``store.create_trial``) or assigns pack work
+to a chip (``tasks.put(("pack", ...))`` / ``tasks.put(("resume",
+...))``) WITHOUT an intent first is invisible to that reconciliation:
+a crash between the bare mutation and completion leaves a row no WAL
+claim covers, and resume refuses the whole job (``unlogged_claim``).
+
+Flagged inside ``rafiki_tpu/scheduler/`` only: a function that calls
+one of the mutating operations with no lexically preceding ``intent(``
+call in the same function. The guarded-WAL idiom (``txn = None if wal
+is None else wal.intent(...)``) counts — the intent call is present;
+whether it runs is the degraded no-WAL mode recovery handles loudly.
+
+Legitimate undurable mutations (a test double, a path the WAL covers
+one frame up) justify-suppress with ``# lint: disable=RF013 — why``,
+stating which layer writes the intent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+
+#: The package whose durability contract this checker enforces.
+SCOPE = "rafiki_tpu.scheduler"
+
+#: Method names that claim a budget slot when called on anything.
+CLAIMING_ATTRS = ("create_trial",)
+
+#: First elements of a task tuple whose ``.put()`` assigns chip work.
+ASSIGNING_TASKS = ("pack", "resume")
+
+
+def _own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's body WITHOUT descending into nested function
+    definitions — a closure is its own durability scope (it is flagged
+    separately when it mutates without an intent of its own)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _mutation(node: ast.AST) -> Optional[Tuple[str, ast.Call]]:
+    """(description, call) when ``node`` is a durable-decision mutation."""
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    if func.attr in CLAIMING_ATTRS:
+        return f"`.{func.attr}(...)` (budget claim)", node
+    if func.attr == "put" and node.args:
+        arg = node.args[0]
+        if (isinstance(arg, ast.Tuple) and arg.elts
+                and isinstance(arg.elts[0], ast.Constant)
+                and arg.elts[0].value in ASSIGNING_TASKS):
+            return (f'`.put(("{arg.elts[0].value}", ...))` '
+                    f"(pack assignment)", node)
+    return None
+
+
+def _is_intent_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr == "intent"
+    return isinstance(func, ast.Name) and func.id == "intent"
+
+
+@register
+class UndurableDecision(Checker):
+    id = "RF013"
+    name = "undurable-decision"
+    severity = "error"
+    rationale = ("a scheduler mutation (trial claim, pack assignment) "
+                 "with no preceding WAL intent() in the same function "
+                 "is invisible to resume_sweep's WAL-vs-store "
+                 "reconciliation — a crash around it strands the job "
+                 "unresumable (`unlogged_claim`); write the intent "
+                 "first, or justify-suppress naming the layer that does")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not ctx.module_name.startswith(SCOPE):
+            return []
+        findings: List[Finding] = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            first_intent = None
+            for node in _own_nodes(fn):
+                if _is_intent_call(node):
+                    line = getattr(node, "lineno", None)
+                    if line is not None and (first_intent is None
+                                             or line < first_intent):
+                        first_intent = line
+            for node in _own_nodes(fn):
+                mut = _mutation(node)
+                if mut is None:
+                    continue
+                desc, call = mut
+                if first_intent is None or call.lineno < first_intent:
+                    findings.append(self.finding(
+                        ctx, call,
+                        f"`{fn.name}` executes {desc} with no WAL "
+                        f"`intent(...)` written first in this function "
+                        f"— the mutation is undurable, and a crash "
+                        f"around it makes the job unresumable "
+                        f"(resume_sweep reconciliation reports "
+                        f"`unlogged_claim`); log the intent before "
+                        f"mutating"))
+        return findings

@@ -39,6 +39,7 @@ _RECOVERY_SCENARIOS = frozenset({
     "checkpoint-write-failure", "drain-under-load",
     "mesh-chip-loss-repack", "collective-kill-mid-step",
     "mesh-degrades-single-chip", "load-spike-scale-up",
+    "supervisor-kill-mid-sweep", "host-loss-mid-sweep",
 })
 
 # Subprocess-killing scenarios must be reconstructible from the

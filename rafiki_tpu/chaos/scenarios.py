@@ -72,6 +72,16 @@ load-spike-scale-up            the only serving replica pinned 0.3s slow:
                                autoscale controller scales the lane up, and
                                the spike recovers — recovery-time-to-SLO
                                recorded for the bench trend gate
+supervisor-kill-mid-sweep      SIGKILL the whole sweep-supervisor process
+                               mid-sweep: resume_sweep in a fresh process
+                               reconciles the WAL (zero double-claims),
+                               rehydrates the GP advisor, adopts every
+                               orphan, and the resumed sweep's best score
+                               and knob set equal an unfaulted run's
+host-loss-mid-sweep            two whole-host losses: survivors re-pack
+                               the first lost host's rows, the second
+                               loss takes the supervisor, resume adopts
+                               the rest and finishes the budget
 autoscale-flap-damping         an adversarial square-wave pressure signal
                                (plus injected sensor faults) on a fake
                                clock: damping bounds the actuation count
@@ -1175,3 +1185,268 @@ def autoscale_flap_damping(tmp, check: CheckFn) -> None:
     check("sensor_fault_fired",
           any(site == "autoscale.sensor" for site, _mode, _hit, key in fired),
           f"schedule: {fired}")
+
+
+# ---------------------------------------------------------------------------
+# Control-plane crash scenarios (docs/recovery.md): the sweep runs in
+# a subprocess of its own (scheduler/sweep_proc.py) so a supervisor
+# kill takes out the WHOLE control plane — advisor state, pack
+# assignments, heartbeats — and resume_sweep must prove a genuinely
+# fresh process adopts the job from the MetaStore + sweep WAL +
+# journals alone.
+# ---------------------------------------------------------------------------
+
+def _sweep_proc_env(extra: Optional[Dict[str, str]] = None,
+                    chaos: bool = True) -> Dict[str, str]:
+    """Child env for a sweep_proc subprocess: inherits the runner's
+    installed chaos/journal env, pins the repo importable regardless of
+    cwd, and (chaos=False) strips the fault spec for resume/reference
+    children that must run unfaulted."""
+    import os
+    from pathlib import Path
+
+    import rafiki_tpu
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (str(Path(rafiki_tpu.__file__).resolve().parents[1]),
+                    env.get("PYTHONPATH", "")) if p)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if not chaos:
+        env.pop("RAFIKI_CHAOS", None)
+    return env
+
+
+def _sweep_proc(mode: str, store, params, job_id: str, *, chips: int,
+                trials_per_chip: int, env: Dict[str, str],
+                advisor: Optional[str] = None,
+                advisor_kwargs: Optional[str] = None,
+                stale_after_s: Optional[float] = None,
+                timeout: float = 240.0):
+    import json as _json
+    import subprocess
+    import sys
+
+    argv = [sys.executable, "-m", "rafiki_tpu.scheduler.sweep_proc", mode,
+            "--db", str(store.path), "--params", str(params.directory),
+            "--job", job_id, "--chips", str(chips),
+            "--trials-per-chip", str(trials_per_chip)]
+    if advisor:
+        argv += ["--advisor", advisor]
+    if advisor_kwargs:
+        argv += ["--advisor-kwargs", advisor_kwargs]
+    if stale_after_s is not None:
+        argv += ["--stale-after-s", str(stale_after_s)]
+    proc = subprocess.run(argv, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+    summary = {}
+    if proc.stdout.strip():
+        try:
+            summary = _json.loads(proc.stdout.strip().splitlines()[-1])
+        except ValueError:
+            summary = {}
+    return proc, summary
+
+
+@scenario(
+    "supervisor-kill-mid-sweep",
+    "SIGKILL the whole sweep-supervisor process mid-sweep (after its "
+    "warmup claims, before any trial completes): resume_sweep in a "
+    "fresh process must reconcile the WAL with zero double-claimed "
+    "slots, rehydrate the GP advisor, adopt every orphan, and finish "
+    "the job with the SAME best score and knob set as an unfaulted "
+    "run under the same seeds — with a non-warmup post-resume "
+    "propose_batch proving the advisor continued, not restarted.",
+    spec="seed=23;supervisor.tick:kill:after=30:times=1:match=g0",
+    env={"RAFIKI_CHECKPOINT_EVERY": "1",
+         "RAFIKI_SUPERVISOR_HEARTBEAT_S": "0.2"},
+)
+def supervisor_kill_mid_sweep(tmp, check: CheckFn) -> None:
+    import json as _json
+    import subprocess
+    import sys
+    import time as _time
+
+    from rafiki_tpu.obs import journal as journal_mod
+    from rafiki_tpu.scheduler.wal import read_wal, reconcile, wal_path
+
+    # Budget == chips * trials_per_chip == GP n_initial: every claim is
+    # a seed-deterministic warmup proposal made up-front, so ONE plain
+    # unfaulted run is a complete best-score reference and the faulted
+    # run's kill (supervisor.tick only exists post-claims) cannot
+    # change which knobs were claimed.
+    BUDGET, CHIPS, K = 4, 2, 2
+    fd = tmp / "faulted"
+    fd.mkdir(parents=True, exist_ok=True)
+    store, params, model = _train_env(fd)
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": BUDGET})
+
+    p1, _ = _sweep_proc("run", store, params, job["id"], chips=CHIPS,
+                        trials_per_chip=K, env=_sweep_proc_env(),
+                        advisor="gp", advisor_kwargs='{"n_initial": 4}')
+    check("supervisor_killed", p1.returncode == -9,
+          f"run rc={p1.returncode}: {p1.stderr[-500:]}")
+
+    _time.sleep(0.5)
+    p2, summary = _sweep_proc("resume", store, params, job["id"],
+                              chips=CHIPS, trials_per_chip=K,
+                              env=_sweep_proc_env(chaos=False),
+                              stale_after_s=0.4)
+    check("resume_completed", p2.returncode == 0,
+          f"resume rc={p2.returncode}: {p2.stderr[-800:]}")
+    check("resume_adopted_orphans", summary.get("adopted", 0) >= 1, summary)
+    check("resume_mode_wal", summary.get("mode") == "wal", summary)
+    trials = _check_rows(check, store, job["id"], expect=BUDGET)
+
+    # Acceptance (b): WAL-vs-store reconcile proves zero slots claimed
+    # twice — every trial row covered by exactly one claim record.
+    recs = read_wal(wal_path(store.path, job["id"]))
+    for sub in store.get_sub_train_jobs(job["id"]):
+        r = reconcile(recs, store.get_trials_of_sub_train_job(sub["id"]),
+                      sub=sub, sub_id=sub["id"])
+        check("wal_reconciles_clean", r.ok, r.summary())
+        check("no_double_claims",
+              all(n == 1 for n in r.claims.values()), r.summary())
+
+    # Acceptance (a): unfaulted reference run, same seeds, own journal
+    # dir so the faulted job's timeline stays uncontaminated.
+    rd = tmp / "reference"
+    rd.mkdir(parents=True, exist_ok=True)
+    rstore, rparams, rmodel = _train_env(rd)
+    rjob = _make_job(rstore, rmodel, {"MODEL_TRIAL_COUNT": BUDGET})
+    renv = _sweep_proc_env(chaos=False)
+    renv["RAFIKI_LOG_DIR"] = str(rd / "obs")
+    p3, _ = _sweep_proc("run", rstore, rparams, rjob["id"], chips=CHIPS,
+                        trials_per_chip=K, env=renv, advisor="gp",
+                        advisor_kwargs='{"n_initial": 4}')
+    check("reference_completed", p3.returncode == 0,
+          f"reference rc={p3.returncode}: {p3.stderr[-500:]}")
+    rtrials = rstore.get_trials_of_train_job(rjob["id"])
+    best_f = max((t["score"] for t in trials
+                  if t["score"] is not None), default=None)
+    best_r = max((t["score"] for t in rtrials
+                  if t["score"] is not None), default=None)
+    check("best_score_matches_unfaulted",
+          best_f is not None and best_f == best_r,
+          f"faulted {best_f} vs unfaulted {best_r}")
+    knobs_f = sorted(_json.dumps(t["knobs"], sort_keys=True)
+                     for t in trials)
+    knobs_r = sorted(_json.dumps(t["knobs"], sort_keys=True)
+                     for t in rtrials)
+    check("knob_set_matches_unfaulted", knobs_f == knobs_r,
+          "resumed sweep explored different knobs than unfaulted run")
+
+    # Acceptance (c): the post-resume propose_batch shows non-warmup
+    # internals — the rehydrated GP drafted with constant-liar, it did
+    # not restart from scratch.
+    jrecs = journal_mod.read_dir(journal_mod.journal.log_dir)
+    check("advisor_rehydrated",
+          _journal_has(jrecs, "recovery", "rehydrated"),
+          "no recovery/rehydrated journal record")
+    batches = [r for r in jrecs if r.get("kind") == "advisor"
+               and r.get("name") == "propose_batch"]
+    check("post_resume_batch_non_warmup",
+          any(b.get("strategy") == "constant_liar_min" for b in batches),
+          f"batch strategies: {[b.get('strategy') for b in batches]}")
+    check("kill_injected_journaled",
+          any(r.get("kind") == "chaos" and r.get("mode") == "kill"
+              and r.get("site") == "supervisor.tick" for r in jrecs),
+          "no chaos/injected supervisor.tick kill in journals")
+
+    # The crash->adopt->complete story reconstructs from the journals
+    # alone via the obs CLI verb.
+    p4 = subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.obs", "--dir",
+         str(journal_mod.journal.log_dir), "resume", job["id"]],
+        env=_sweep_proc_env(chaos=False), capture_output=True, text=True,
+        timeout=60)
+    check("obs_resume_reconstructs", p4.returncode == 0
+          and "resumed:" in p4.stdout,
+          f"rc={p4.returncode}: {p4.stderr[-400:]}")
+
+
+@scenario(
+    "host-loss-mid-sweep",
+    "Two whole-host losses in one 4-chip / 2-hosts sweep: host 1 "
+    "(chips 2,3) is lost first via the host.loss chaos site and the "
+    "survivors must re-pack its rows; then host 0 dies taking the "
+    "supervisor with it (SIGKILL fired the moment the re-pack hits "
+    "the journal — state-triggered, so the ordering is robust to "
+    "machine speed), and resume_sweep must adopt the rest and finish "
+    "the full budget with clean WAL accounting.",
+    spec="seed=29;host.loss:kill:after=2:times=1:match=g0h1",
+    env={"RAFIKI_CHECKPOINT_EVERY": "1",
+         "RAFIKI_SUPERVISOR_HEARTBEAT_S": "0.2",
+         "RAFIKI_MESH_CHIPS_PER_HOST": "2"},
+)
+def host_loss_mid_sweep(tmp, check: CheckFn) -> None:
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    from rafiki_tpu.obs import journal as journal_mod
+    from rafiki_tpu.scheduler.wal import read_wal, reconcile, wal_path
+
+    BUDGET, CHIPS, K = 8, 4, 2
+    store, params, model = _train_env(tmp)
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": BUDGET})
+
+    # Host 0's loss cannot be tick-scheduled: the epoch boundary that
+    # unwinds host 1's aborted packs arrives at wildly machine-
+    # dependent times (jit compile contention), and killing before the
+    # re-pack would test the supervisor-kill path, not host ordering.
+    # So the body watches the shared journal dir for the mesh/repack
+    # record and THEN kills the supervisor process — the same SIGKILL
+    # a real host loss delivers, triggered by cluster state.
+    argv = [sys.executable, "-m", "rafiki_tpu.scheduler.sweep_proc", "run",
+            "--db", str(store.path), "--params", str(params.directory),
+            "--job", job["id"], "--chips", str(CHIPS),
+            "--trials-per-chip", str(K), "--advisor", "random"]
+    child = subprocess.Popen(argv, env=_sweep_proc_env(),
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True)
+    log_dir = journal_mod.journal.log_dir
+    deadline = _time.monotonic() + 120.0
+    repacked = False
+    while _time.monotonic() < deadline and child.poll() is None:
+        if any(r.get("kind") == "mesh" and r.get("name") == "repack"
+               for r in journal_mod.read_dir(log_dir)):
+            repacked = True
+            break
+        _time.sleep(0.1)
+    if child.poll() is None:
+        child.send_signal(signal.SIGKILL)
+    child.communicate(timeout=60)
+    check("repack_seen_before_host0_loss", repacked,
+          "mesh/repack never hit the journals before timeout/exit")
+    check("supervisor_host_killed", child.returncode == -9,
+          f"run rc={child.returncode}")
+
+    # Survivors re-packed host 1's rows BEFORE host 0 died: the
+    # host-loss and re-pack story is already in the journals.
+    jrecs = journal_mod.read_dir(journal_mod.journal.log_dir)
+    host_lost = [r for r in jrecs if r.get("kind") == "mesh"
+                 and r.get("name") == "host_lost"]
+    check("host1_loss_journaled",
+          any(r.get("host") == 1 for r in host_lost),
+          f"host_lost records: {host_lost}")
+    check("survivors_repacked",
+          _journal_has(jrecs, "mesh", "repack"),
+          "no mesh/repack journal record after host loss")
+
+    _time.sleep(0.5)
+    p2, summary = _sweep_proc("resume", store, params, job["id"],
+                              chips=CHIPS, trials_per_chip=K,
+                              env=_sweep_proc_env(chaos=False),
+                              stale_after_s=0.4)
+    check("resume_completed", p2.returncode == 0,
+          f"resume rc={p2.returncode}: {p2.stderr[-800:]}")
+    check("resume_adopted_orphans", summary.get("adopted", 0) >= 1, summary)
+    _check_rows(check, store, job["id"], expect=BUDGET)
+
+    recs = read_wal(wal_path(store.path, job["id"]))
+    for sub in store.get_sub_train_jobs(job["id"]):
+        r = reconcile(recs, store.get_trials_of_sub_train_job(sub["id"]),
+                      sub=sub, sub_id=sub["id"])
+        check("wal_reconciles_clean", r.ok, r.summary())

@@ -61,6 +61,10 @@ class ServiceType(str, enum.Enum):
     INFERENCE_WORKER = "INFERENCE_WORKER"
     ADVISOR = "ADVISOR"
     PREDICTOR = "PREDICTOR"
+    # The sweep supervisor's liveness lease (docs/recovery.md): a
+    # RUNNING job whose SUPERVISOR heartbeats all went stale is a
+    # crashed control plane — the resume reaper's detection signal.
+    SUPERVISOR = "SUPERVISOR"
 
 
 class ServiceStatus(str, enum.Enum):

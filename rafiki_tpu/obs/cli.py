@@ -45,6 +45,10 @@ Subcommands (all read ``journal-*.jsonl*`` under ``--dir``, default
     lineage [id]   walk one trial across incarnations/chips/packs
                    (evict, backfill, resume, repack); ``--check``
                    exits 1 on orphaned incarnations fleet-wide
+    resume [job]   reconstruct a sweep's crash→adopt→resume timeline
+                   from the ``recovery/*`` + supervisor lifecycle
+                   records; exits 1 when no recovery story exists
+                   (docs/recovery.md)
     autoscale      replay the elasticity controller's decision stream
                    (``autoscale/decision`` + spawn/drain/prewarm):
                    per-tick lane, direction, pressure, reason and the
@@ -716,6 +720,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                              args.window, args.flips)
     if args.cmd == "twin":
         return twin_cli.dispatch(args, log_dir, args.json)
-    if args.cmd in ("sweep", "lineage"):
+    if args.cmd in ("sweep", "lineage", "resume"):
         return search_cli.dispatch(args, log_dir, args.json)
     return cmd_slowest(log_dir, args.n, args.json)

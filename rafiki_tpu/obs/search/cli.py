@@ -17,6 +17,15 @@ Mounted by :mod:`rafiki_tpu.obs.cli` the same way the twin verbs are:
                     packs; omit the trial for the fleet-wide table.
                     ``--check`` exits 1 on orphaned incarnations —
                     trials the fleet lost without writing down why.
+    resume [job]    reconstruct a sweep's crash→detect→adopt→
+                    reconcile→resume timeline from journals alone:
+                    supervisor incarnations, the fault that killed
+                    generation 0, WAL reconcile verdicts, advisor
+                    rehydration, adopted-trial feedback routing, and
+                    the first post-resume proposal batch. Exit 1 when
+                    no recovery records exist for the job — a resume
+                    that leaves no story is itself a failure
+                    (docs/recovery.md).
 """
 
 from __future__ import annotations
@@ -43,11 +52,18 @@ def attach(sub) -> None:
                     help="trial id or unique prefix (omit for all)")
     sp.add_argument("--check", action="store_true",
                     help="exit 1 on orphaned incarnations")
+    sp = sub.add_parser(
+        "resume",
+        help="crash→adopt→resume timeline from recovery records")
+    sp.add_argument("job", nargs="?", default=None,
+                    help="job-id substring filter (omit for all)")
 
 
 def dispatch(args, log_dir: str, as_json: bool) -> int:
     if args.cmd == "sweep":
         return cmd_sweep(args, log_dir, as_json)
+    if args.cmd == "resume":
+        return cmd_resume(args, log_dir, as_json)
     return cmd_lineage(args, log_dir, as_json)
 
 
@@ -162,6 +178,90 @@ def cmd_lineage(args, log_dir: str, as_json: bool) -> int:
                   f"{o['incarnation']} on {o['worker_id']} — last event "
                   f"{o['last_event']}", file=sys.stderr)
         return 1
+    return 0
+
+
+def _resume_timeline(records, job: str = None) -> Dict[str, Any]:
+    """The recovery story, assembled from journals alone — no store,
+    no WAL file. Selects supervisor lifecycle, injected faults,
+    recovery/* verdicts and the post-resume advisor continuation, in
+    timestamp order."""
+    def _match(r) -> bool:
+        return job is None or job in str(r.get("job_id") or "")
+
+    picked = []
+    for r in records:
+        kind, name = r.get("kind"), r.get("name")
+        if kind == "recovery" and _match(r):
+            picked.append(r)
+        elif kind == "mesh" and name in (
+                "supervisor_started", "sweep_started", "host_lost",
+                "chip_lost", "repack", "repack_failed") and _match(r):
+            picked.append(r)
+        elif kind == "chaos":
+            # Fault records carry no job id; scoped by the log dir.
+            picked.append(r)
+        elif (kind == "event" and name in ("trial_orphan_detected",
+                                           "sweep_resumed")):
+            picked.append(r)
+        elif kind == "advisor" and name == "propose_batch" and _match(r):
+            picked.append(r)
+    picked.sort(key=lambda r: r.get("ts", 0.0))
+    generations = sorted({r.get("generation") for r in picked
+                          if r.get("kind") == "mesh"
+                          and r.get("name") == "supervisor_started"
+                          and r.get("generation") is not None})
+    finished = [r for r in picked if r.get("kind") == "recovery"
+                and r.get("name") == "resume_finished"]
+    return {
+        "n_records": len(picked),
+        "generations": generations,
+        "resumes": len(finished),
+        "outcome": finished[-1] if finished else None,
+        "timeline": picked,
+    }
+
+
+def cmd_resume(args, log_dir: str, as_json: bool) -> int:
+    from rafiki_tpu.obs import journal as journal_mod
+
+    records = journal_mod.read_dir(log_dir)
+    doc = _resume_timeline(records, job=args.job)
+    has_recovery = any(r.get("kind") == "recovery"
+                       for r in doc["timeline"])
+    if not has_recovery:
+        print(f"no recovery records under {log_dir}"
+              + (f" for job {args.job!r}" if args.job else "")
+              + " (was resume_sweep ever run? see docs/recovery.md)",
+              file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps(doc, default=str))
+        return 0
+    t0 = doc["timeline"][0].get("ts", 0.0)
+    print(f"resume timeline: {doc['n_records']} records, "
+          f"supervisor generations {doc['generations']}, "
+          f"{doc['resumes']} resume(s)")
+    for r in doc["timeline"]:
+        dt = (r.get("ts") or 0.0) - t0
+        kind, name = r.get("kind"), r.get("name")
+        extra = " ".join(
+            f"{k}={r[k]}" for k in (
+                "generation", "site", "mode", "key", "host", "chip",
+                "ok", "n_claims", "n_in_doubt", "errors",
+                "n_observations", "n_from_store", "n_from_journal",
+                "routed", "score", "adopted", "salvaged", "restarted",
+                "continuation", "strategy", "trial_id", "wall_s")
+            if r.get(k) not in (None, [], ""))
+        print(f"  +{dt:8.3f}s {kind}/{name}"
+              + (f"  [{extra}]" if extra else ""))
+    out = doc["outcome"]
+    if out is not None:
+        print(f"-- resumed: adopted={out.get('adopted')} "
+              f"salvaged={out.get('salvaged')} "
+              f"restarted={out.get('restarted')} "
+              f"continuation={out.get('continuation')} "
+              f"wall={out.get('wall_s')}s")
     return 0
 
 

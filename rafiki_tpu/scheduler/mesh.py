@@ -45,12 +45,39 @@ from rafiki_tpu.model.base import load_model_class
 from rafiki_tpu.model.knobs import knob_config_signature
 from rafiki_tpu.obs.journal import journal as _journal
 from rafiki_tpu.obs.ledger import ledger
+from rafiki_tpu.obs.search.audit import knobs_hash as _knobs_hash
 from rafiki_tpu.parallel.mesh import local_devices
 from rafiki_tpu.scheduler.local import TrainJobResult
+from rafiki_tpu.scheduler.wal import SweepWal
 from rafiki_tpu.store import MetaStore, ParamsStore
 from rafiki_tpu.utils.events import events
 from rafiki_tpu.worker.train import (InProcAdvisorHandle, PackAborted,
                                      PackedTrialRunner, TrainWorker)
+
+
+class _WalAdvisorHandle:
+    """Durability wrapper around the advisor handle: every ``feedback``
+    is intent/commit-bracketed in the sweep WAL before it mutates the
+    in-memory posterior, so ``resume_sweep`` knows exactly which scores
+    the dead advisor had absorbed (docs/recovery.md). Proposals need no
+    WAL record — an unscored proposal is reproducible from the advisor
+    audit journal and claims nothing."""
+
+    def __init__(self, inner, wal: SweepWal):
+        self._inner = inner
+        self._wal = wal
+
+    def propose(self):
+        return self._inner.propose()
+
+    def propose_batch(self, n: int):
+        return self._inner.propose_batch(n)
+
+    def feedback(self, score: float, knobs) -> None:
+        txn = self._wal.intent("advisor_feedback", score=float(score),
+                               knobs_hash=_knobs_hash(knobs))
+        self._inner.feedback(score, knobs)
+        self._wal.commit(txn, "advisor_feedback")
 
 
 class ElasticHandle:
@@ -193,6 +220,9 @@ class MeshSweepScheduler:
         self.store = store
         self.params_store = params_store
         self.advisors = advisor_service or AdvisorService()
+        self._wal: Optional[SweepWal] = None
+        self._generation = 0
+        self._sup_service_id: Optional[str] = None
 
     # -- mesh formation ------------------------------------------------------
 
@@ -253,10 +283,16 @@ class MeshSweepScheduler:
         advisor_kind: str = "gp",
         stop_event: Optional[threading.Event] = None,
         elastic: Optional[ElasticHandle] = None,
+        generation: int = 0,
+        advisor_kwargs: Optional[Dict[str, Any]] = None,
     ) -> TrainJobResult:
         """Run a train job as one mesh sweep to budget exhaustion.
         ``elastic``, when given, lets the autoscale controller grow and
-        shrink the chip count while the sweep runs."""
+        shrink the chip count while the sweep runs. ``generation``
+        distinguishes supervisor incarnations of the same job (0 = the
+        original; ``resume_sweep`` runs at generation+1) — it tags the
+        WAL records and the ``supervisor.tick``/``host.loss`` chaos keys
+        so a kill fault can be scoped to one incarnation."""
         t0 = time.monotonic()
         job = self.store.get_train_job(job_id)
         if job is None:
@@ -269,8 +305,33 @@ class MeshSweepScheduler:
         budget = dict(job["budget"])
         chip_budget = budget.get("CHIP_COUNT") or budget.get("GPU_COUNT")
         want = int(chips or chip_budget or 8)
+
+        # Durable control-plane log + the supervisor's liveness lease:
+        # both must exist BEFORE any budget mutation, so a resumer can
+        # (a) find the sweep's config without this process and (b) tell
+        # a dead supervisor from a slow one (docs/recovery.md).
+        self._generation = int(generation)
+        self._wal = SweepWal.for_job(self.store, job_id,
+                                     generation=self._generation)
+        self._wal.note("sweep_config", job_id=job_id,
+                       advisor_kind=advisor_kind,
+                       advisor_kwargs=advisor_kwargs or {},
+                       chips=want, trials_per_chip=int(trials_per_chip))
+        sup = self.store.create_service(ServiceType.SUPERVISOR.value,
+                                        job_id=job_id,
+                                        worker_index=self._generation)
+        self._sup_service_id = sup["id"]
+        self.store.update_service(sup["id"],
+                                  status=ServiceStatus.RUNNING.value,
+                                  heartbeat=True)
+        _journal.record("mesh", "supervisor_started", job_id=job_id,
+                        generation=self._generation, service_id=sup["id"])
+
         devices, degraded = self._form_mesh(want)
         if not devices:
+            self.store.update_service(sup["id"],
+                                      status=ServiceStatus.STOPPED.value)
+            self._wal.close()
             self.store.update_train_job_status(job_id,
                                                TrainJobStatus.ERRORED.value)
             for sub in self.store.get_sub_train_jobs(job_id):
@@ -313,7 +374,8 @@ class MeshSweepScheduler:
                 continue
             advisor_id = self.advisors.create_advisor(
                 model_cls.get_knob_config(), kind=advisor_kind,
-                advisor_id=sub.get("advisor_id") or None)
+                advisor_id=sub.get("advisor_id") or None,
+                engine_kwargs=advisor_kwargs)
             try:
                 # Stamp the job onto the engine so its advisor/*
                 # journal records answer `obs sweep <job>` directly.
@@ -322,7 +384,8 @@ class MeshSweepScheduler:
                 pass
             self.store.update_sub_train_job(sub["id"], advisor_id=advisor_id,
                                             status=TrainJobStatus.RUNNING.value)
-            handle = InProcAdvisorHandle(self.advisors, advisor_id)
+            handle = _WalAdvisorHandle(
+                InProcAdvisorHandle(self.advisors, advisor_id), self._wal)
 
             self._run_sub(job, sub, model_cls, handle, devices, k,
                           budget, errors, stop_event, elastic=elastic)
@@ -347,6 +410,12 @@ class MeshSweepScheduler:
         else:
             status = TrainJobStatus.COMPLETED.value
         self.store.update_train_job_status(job_id, status)
+        # Clean shutdown: release the liveness lease and the WAL handle.
+        # On a crash neither line runs — exactly the signal the resume
+        # reaper keys on (stale SUPERVISOR heartbeat + RUNNING job).
+        self.store.update_service(sup["id"],
+                                  status=ServiceStatus.STOPPED.value)
+        self._wal.close()
         telemetry.inc("scheduler.train_jobs_finished")
         # lint: disable=RF007 — job duration observed into train_job_s right here
         dur_s = time.monotonic() - t0
@@ -402,12 +471,20 @@ class MeshSweepScheduler:
                 job_created_at=job["created_at"], service_id=service["id"],
                 stop_event=stop_event, async_persist=False,
             )
+            # The mid-pack backfill closure claims budget slots from
+            # inside the worker — hand it the WAL so those claims are
+            # intent/commit-bracketed like the up-front ones.
+            worker.wal = self._wal
             runners.append(_ChipRunner(i, dev, worker, k, errors,
                                        budget_max=budget_max))
 
         # Claim every row up front (atomic budget slots), bucketed by
         # packing key — only same-key rows may share a pack — then
-        # round-robin each bucket across chips.
+        # round-robin each bucket across chips. Each claim is WAL
+        # intent/commit-bracketed: a resumer reconciles these records
+        # against the trial rows to prove every budget slot was claimed
+        # exactly once (docs/recovery.md).
+        wal = self._wal
         buckets: Dict[str, List[tuple]] = {}
         order: List[str] = []
         for kn in proposals:
@@ -417,12 +494,16 @@ class MeshSweepScheduler:
                     job["train_dataset_uri"])))
             except Exception:
                 key = f"unpackable:{id(kn)}"  # its own singleton pack
+            txn = wal.intent("budget_claim", sub_id=sub["id"],
+                             knobs_hash=_knobs_hash(kn))
             trial = self.store.create_trial(
                 sub["id"], model_cls.__name__, kn,
                 shape_sig=knob_config_signature(knob_config, kn),
                 budget_max=budget_max)
             if trial is None:
+                wal.commit(txn, "budget_claim", denied=True)
                 break  # budget drained under us
+            wal.commit(txn, "budget_claim", trial_id=trial["id"])
             if key not in buckets:
                 order.append(key)
                 buckets[key] = []
@@ -439,6 +520,8 @@ class MeshSweepScheduler:
         for r, per_bucket in zip(runners, assign):
             for rows in per_bucket:
                 if rows:
+                    txn = wal.intent("pack_assign", chip=r.index,
+                                     trial_ids=[tid for tid, _kn in rows])
                     # Bind the rows to their chip's service so a later
                     # chip loss can find exactly this chip's orphans.
                     for tid, _kn in rows:
@@ -446,6 +529,7 @@ class MeshSweepScheduler:
                             tid, service_id=r.service_id,
                             worker_id=r.worker.worker_id)
                     r.tasks.put(("pack", rows))
+                    wal.commit(txn, "pack_assign")
         _journal.record("mesh", "sweep_started", job_id=job_id,
                         chips=n_chips, trials_per_chip=k,
                         n_trials=sum(len(v) for v in buckets.values()))
@@ -479,6 +563,7 @@ class MeshSweepScheduler:
                 job_created_at=job["created_at"], service_id=service["id"],
                 stop_event=stop_event, async_persist=False,
             )
+            worker.wal = self._wal
             r = _ChipRunner(i, dev, worker, k, errors,
                             budget_max=budget_max)
             r.thread.start()
@@ -505,7 +590,46 @@ class MeshSweepScheduler:
         sweep is drained."""
         lost_at: Dict[int, float] = {}
         rr = 0  # round-robin cursor over survivors for re-packed rows
+        gen = self._generation
+        hb_s = float(os.environ.get("RAFIKI_SUPERVISOR_HEARTBEAT_S", "5"))
+        last_beat = time.monotonic()
+        # Simulated host topology: with RAFIKI_MESH_CHIPS_PER_HOST=n,
+        # chips i//n share a "host"; host 0 also carries the supervisor.
+        # The host.loss chaos site kills whole groups at once — host 0
+        # via self-directed hook() (supervisor dies with its chips, the
+        # resume path takes over), others via decide() + group abort
+        # (survivors re-pack: the chip-loss path at host granularity).
+        per_host = int(os.environ.get("RAFIKI_MESH_CHIPS_PER_HOST", "0") or 0)
         while True:
+            # supervisor.tick: the kill-the-supervisor injection point
+            # (SIGKILL of this whole process, chip threads included).
+            chaos.hook("supervisor.tick", key=f"g{gen}")
+            now = time.monotonic()
+            if self._sup_service_id and now - last_beat >= hb_s / 2.0:
+                last_beat = now
+                self.store.update_service(self._sup_service_id,
+                                          heartbeat=True)
+            if per_host > 0:
+                hosts = sorted({r.index // per_host for r in runners
+                                if r.alive()})
+                for h in hosts:
+                    if h == 0:
+                        chaos.hook("host.loss", key=f"g{gen}h0")
+                        continue
+                    decision = chaos.decide("host.loss", key=f"g{gen}h{h}")
+                    if decision is not None and decision.mode in (
+                            "kill", "term", "preempt"):
+                        victims = [r for r in runners if r.alive()
+                                   and r.index // per_host == h]
+                        for r in victims:
+                            r.abort.set()
+                            lost_at[r.index] = time.monotonic()
+                        _journal.record("mesh", "host_lost", job_id=job_id,
+                                        host=h,
+                                        chips=[r.index for r in victims])
+                        events.emit("mesh_host_lost", job_id=job_id,
+                                    host=h,
+                                    chips=[r.index for r in victims])
             if elastic is not None:
                 elastic._set_live(sum(1 for r in runners if r.alive()))
                 delta = elastic._take()
@@ -594,6 +718,9 @@ class MeshSweepScheduler:
                 for tid in orphans:
                     target = survivors[rr % len(survivors)]
                     rr += 1
+                    txn = self._wal.intent("pack_assign",
+                                           chip=target.index,
+                                           trial_ids=[tid], repack=True)
                     # Re-bind BEFORE enqueueing: if the target chip
                     # dies with this resume still queued, the next
                     # reap's orphan query must find the row under the
@@ -602,6 +729,7 @@ class MeshSweepScheduler:
                         tid, service_id=target.service_id,
                         worker_id=target.worker.worker_id)
                     target.tasks.put(("resume", tid))
+                    self._wal.commit(txn, "pack_assign")
                 _journal.record("mesh", "repack", job_id=job_id,
                                 chip=r.index, moved=orphans,
                                 survivors=[s.index for s in survivors])

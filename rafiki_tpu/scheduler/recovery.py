@@ -1,4 +1,5 @@
-"""Failure detection + elastic recovery of orphaned trials.
+"""Failure detection + elastic recovery of orphaned trials, and whole-
+sweep crash resume (docs/recovery.md).
 
 Reference parity and beyond: SURVEY.md §5 — the reference's recovery
 is Docker-restart + mark-trial-ERRORED-and-move-on; a crashed trial's
@@ -8,30 +9,73 @@ trials in the trial loop, and within trials via the epoch-log sink),
 died or went silent, and ``recover_orphaned_trials`` re-adopts them —
 resuming from the newest mid-trial checkpoint when one exists.
 
+``resume_sweep`` goes further: a fresh process adopts a DEAD
+SUPERVISOR'S ENTIRE JOB. It reconciles the sweep WAL
+(scheduler/wal.py) against the MetaStore rows to prove the budget
+invariant, rehydrates the dead sweep's advisor from completed-trial
+rows plus ``kind="advisor"`` audit journals (advisor/rehydrate.py),
+re-claims orphaned trials idempotently (double-resume loses the CAS
+and backs off), then re-enters ``MeshSweepScheduler.run_sweep`` at
+generation+1 to spend whatever budget remains — so ``propose_batch``
+continues from an equivalent posterior, not from scratch.
+
 ``stale_after_s`` must exceed the longest epoch (heartbeats are
 per-epoch inside a trial).
 """
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+import os
+import time
+from typing import Any, Dict, List, Optional
 
-from rafiki_tpu.constants import ServiceStatus, ServiceType
+from rafiki_tpu.constants import (
+    BudgetType,
+    ServiceStatus,
+    ServiceType,
+    TrainJobStatus,
+    TrialStatus,
+)
+from rafiki_tpu.obs.journal import journal as _journal
+from rafiki_tpu.obs.journal import read_dir as _read_journal_dir
+from rafiki_tpu.scheduler.wal import SweepWal, read_wal, reconcile, wal_path
 from rafiki_tpu.store import MetaStore, ParamsStore
 from rafiki_tpu.utils.events import events
 from rafiki_tpu.worker.train import build_worker_from_store
 
+#: knobs for the resume path (docs/recovery.md): how stale a heartbeat
+#: must be before a supervisor/worker counts as dead, and how often the
+#: services-manager reaper polls for dead supervisors.
+ENV_RESUME_STALE_S = "RAFIKI_RESUME_STALE_S"
+ENV_RESUME_POLL_S = "RAFIKI_RESUME_POLL_S"
+
+_TERMINAL_JOB = (TrainJobStatus.COMPLETED.value, TrainJobStatus.ERRORED.value,
+                 TrainJobStatus.STOPPED.value)
+
 
 class _RecoveryAdvisor:
     """Advisor handle for adopted trials: knobs are already chosen, so
-    propose() is never valid; feedback is accepted and dropped (the
-    original advisor is usually gone with its job)."""
+    propose() is never valid; feedback is journaled and — when a
+    rehydrated advisor handle is supplied — routed into it, so scores
+    earned by adopted trials inform post-resume proposals instead of
+    being silently dropped."""
+
+    def __init__(self, inner=None):
+        self._inner = inner
 
     def propose(self):
         raise RuntimeError("Recovery workers do not propose new trials")
 
+    def propose_batch(self, n: int):
+        raise RuntimeError("Recovery workers do not propose new trials")
+
     def feedback(self, score: float, knobs) -> None:
-        pass
+        from rafiki_tpu.obs.search.audit import knobs_hash
+        routed = self._inner is not None
+        if routed:
+            self._inner.feedback(score, knobs)
+        _journal.record("recovery", "feedback", score=float(score),
+                        knobs_hash=knobs_hash(knobs), routed=routed)
 
 
 def recover_orphaned_trials(
@@ -52,6 +96,8 @@ def recover_orphaned_trials(
     """
     orphans = orphans if orphans is not None \
         else store.get_orphaned_trials(stale_after_s, sub_train_job_id)
+    if not isinstance(advisor, _RecoveryAdvisor):
+        advisor = _RecoveryAdvisor(advisor)
     # Claim every orphan up front via the atomic compare-and-swap
     # (status + observed owner): a sweep racing this one loses the CAS
     # on any trial we win, so each orphan is adopted exactly once.
@@ -60,7 +106,8 @@ def recover_orphaned_trials(
         service = store.create_service(ServiceType.TRAIN_WORKER.value)
         worker_id = f"recovery-{trial['id'][:8]}"
         if not store.adopt_trial(trial["id"], trial.get("service_id"),
-                                 service["id"], worker_id):
+                                 service["id"], worker_id,
+                                 expected_status=trial.get("status")):
             # Lost the race (another sweep adopted it, or the original
             # worker finished after all) — leave it alone.
             store.update_service(service["id"],
@@ -98,7 +145,7 @@ def recover_orphaned_trials(
         for trial, service, worker_id in claimed:
             worker = build_worker_from_store(
                 store, params_store, trial["sub_train_job_id"],
-                advisor or _RecoveryAdvisor(),
+                advisor,
                 worker_id=worker_id, devices=devices,
                 async_persist=False)  # recovery is synchronous; no saver thread
             worker.service_id = service["id"]
@@ -117,3 +164,222 @@ def recover_orphaned_trials(
         stop_beat.set()
         beater.join(timeout=5)
     return results
+
+
+# ---------------------------------------------------------------------------
+# Whole-sweep resume
+# ---------------------------------------------------------------------------
+
+def _journal_records() -> List[Dict[str, Any]]:
+    """Every journal record reachable from this process (configured
+    sink dir, or RAFIKI_LOG_DIR) — the advisor-audit source for
+    rehydration. Empty when no journal was ever configured."""
+    d = _journal.log_dir or os.environ.get("RAFIKI_LOG_DIR")
+    if not d:
+        return []
+    try:
+        return _read_journal_dir(d)
+    except OSError:
+        return []
+
+
+def resume_sweep(
+    store: MetaStore,
+    params_store: ParamsStore,
+    job_id: str,
+    *,
+    chips: Optional[int] = None,
+    trials_per_chip: Optional[int] = None,
+    stale_after_s: Optional[float] = None,
+    devices: Optional[List[Any]] = None,
+    advisor_service=None,
+    stop_event=None,
+) -> Dict[str, Any]:
+    """Adopt a dead supervisor's train job and drive it to completion.
+
+    The crash→detect→adopt→reconcile→resume lifecycle
+    (docs/recovery.md), in order:
+
+    1. Read the sweep WAL. No WAL → degrade LOUDLY to plain orphan-
+       trial recovery (pre-WAL jobs are still salvageable, just not
+       provable or continuable).
+    2. Per sub job: ``reconcile`` WAL claims against trial rows —
+       refuse to proceed (``WalReconcileError``) if the budget
+       invariant doesn't hold.
+    3. Rehydrate the advisor under the dead sweep's advisor_id from
+       completed rows + advisor audit journals.
+    4. CAS-adopt orphaned trials (stale-hearted AND claimed-but-never-
+       assigned rows) and re-run them, feedback routed into the
+       rehydrated advisor. A concurrent resumer loses the CAS per
+       trial and backs off — double-resume is a no-op.
+    5. Re-enter ``run_sweep`` at generation+1 with the WAL'd sweep
+       config, so remaining budget is spent from the rehydrated
+       posterior. Terminal job + nothing adopted → skip (no-op).
+
+    Returns a summary dict (mode, generation, adopted/salvaged/
+    restarted counts, reconcile summaries, continuation status).
+    """
+    t0 = time.monotonic()
+    stale = float(stale_after_s if stale_after_s is not None
+                  else os.environ.get(ENV_RESUME_STALE_S, "30"))
+    job = store.get_train_job(job_id)
+    if job is None:
+        raise KeyError(f"No train job {job_id!r}")
+    wal_p = wal_path(store.path, job_id)
+    _journal.record("recovery", "resume_started", job_id=job_id,
+                    job_status=job["status"], wal=str(wal_p),
+                    stale_after_s=stale)
+
+    summary: Dict[str, Any] = {
+        "job_id": job_id, "mode": "wal", "generation": None,
+        "adopted": 0, "salvaged": 0, "restarted": 0,
+        "reconcile": [], "continuation": None, "wall_s": None,
+    }
+
+    records = read_wal(wal_p)
+    if not records:
+        # Pre-WAL job (or the WAL dir was lost): there is nothing to
+        # reconcile and no config to continue from. Degrade to orphan-
+        # trial recovery — and say so in the journal, loudly, because
+        # the budget invariant is now unprovable for this job.
+        _journal.record("recovery", "no_wal", job_id=job_id,
+                        wal=str(wal_p),
+                        note="degrading to orphan-trial recovery; budget "
+                             "invariant unprovable, no sweep continuation")
+        rows = recover_orphaned_trials(store, params_store, stale,
+                                       devices=devices)
+        summary["mode"] = "orphan_only"
+        summary["adopted"] = len(rows)
+        summary["wall_s"] = round(time.monotonic() - t0, 3)
+        _journal.record("recovery", "resume_finished", job_id=job_id,
+                        **{k: v for k, v in summary.items()
+                           if k not in ("job_id", "reconcile")})
+        return summary
+
+    cfg: Dict[str, Any] = {}
+    for r in records:
+        if r.get("rec") == "note" and r.get("op") == "sweep_config":
+            cfg = r  # last one wins (each generation re-notes it)
+    generation = max(int(r.get("gen") or 0) for r in records) + 1
+    summary["generation"] = generation
+
+    from rafiki_tpu.advisor.rehydrate import rehydrate_advisor
+    from rafiki_tpu.advisor.service import AdvisorService
+    # Lazy: mesh imports worker/train and the full scheduler surface;
+    # recovery must stay importable from lightweight CLI paths.
+    from rafiki_tpu.model.base import load_model_class
+    from rafiki_tpu.scheduler.mesh import MeshSweepScheduler, _WalAdvisorHandle
+    from rafiki_tpu.worker.train import InProcAdvisorHandle
+
+    advisors = advisor_service or AdvisorService()
+    wal = SweepWal.for_job(store, job_id, generation=generation)
+    jrecords = _journal_records()
+
+    # The sub's atomic `claimed` counter only advances when the job has
+    # a trial-count budget (create_trial claims a slot in the same
+    # txn); without one it stays 0 and must not be cross-checked.
+    has_count_budget = (dict(job.get("budget") or {})
+                        .get(BudgetType.MODEL_TRIAL_COUNT.value) is not None)
+
+    adopted_rows: List[dict] = []
+    for sub in store.get_sub_train_jobs(job_id):
+        trials = store.get_trials_of_sub_train_job(sub["id"])
+        rec = reconcile(records, trials,
+                        sub=sub if has_count_budget else None,
+                        sub_id=sub["id"])
+        _journal.record("recovery", "reconcile", job_id=job_id,
+                        sub_id=sub["id"], **rec.summary())
+        summary["reconcile"].append({"sub_id": sub["id"], **rec.summary()})
+        if not rec.ok:
+            _journal.record("recovery", "reconcile_failed", job_id=job_id,
+                            sub_id=sub["id"], errors=rec.errors)
+            rec.raise_if_failed()
+
+        # Rehydrate the dead sweep's advisor under its original id so
+        # (a) post-resume audit records join the same sweep and (b) the
+        # continuation run_sweep's idempotent create_advisor reuses
+        # this engine instead of building a cold one.
+        handle = _RecoveryAdvisor()
+        aid = sub.get("advisor_id")
+        if aid:
+            model_row = store.get_model(sub["model_id"])
+            model_cls = load_model_class(model_row["model_file"],
+                                         model_row["model_class"])
+            completed = [t for t in trials
+                         if t["status"] == TrialStatus.COMPLETED.value
+                         and t.get("score") is not None]
+            rehydrate_advisor(
+                advisors, model_cls.get_knob_config(),
+                kind=cfg.get("advisor_kind", "gp"), advisor_id=aid,
+                completed=completed, journal_records=jrecords,
+                seed=int(cfg.get("seed") or 0),
+                engine_kwargs=cfg.get("advisor_kwargs") or None,
+                job_id=job_id)
+            handle = _RecoveryAdvisor(
+                _WalAdvisorHandle(InProcAdvisorHandle(advisors, aid), wal))
+
+        # Orphans: stale-hearted RUNNING rows, PLUS rows the dead
+        # supervisor claimed but never bound to a chip (create_trial
+        # landed, mark_trial_as_running didn't — service_id is NULL, so
+        # get_orphaned_trials deliberately skips them; here the
+        # supervisor is known-dead, so they are provably abandoned).
+        orphans = {t["id"]: t
+                   for t in store.get_orphaned_trials(stale, sub["id"])}
+        for t in trials:
+            if (t["status"] == TrialStatus.RUNNING.value
+                    and not t.get("service_id")):
+                orphans.setdefault(t["id"], t)
+        ordered = sorted(orphans.values(),
+                         key=lambda t: (t.get("no") or 0, t["id"]))
+        if ordered:
+            wal.note("adopt", sub_id=sub["id"],
+                     trial_ids=[t["id"] for t in ordered])
+            had_ckpt = {t["id"]: params_store.latest_checkpoint(t["id"])
+                        is not None for t in ordered}
+            rows = recover_orphaned_trials(
+                store, params_store, stale, sub_train_job_id=sub["id"],
+                devices=devices, advisor=handle, orphans=ordered)
+            adopted_rows.extend(rows)
+            summary["adopted"] += len(rows)
+            for row in rows:
+                if had_ckpt.get(row["id"]):
+                    summary["salvaged"] += 1
+                else:
+                    summary["restarted"] += 1
+
+    # Continuation: spend whatever budget remains from the rehydrated
+    # posterior. run_sweep re-notes the config, takes a fresh
+    # SUPERVISOR lease at this generation, claims remaining slots
+    # atomically (a racing resumer's claims simply drain the budget —
+    # no double-claims), and finalizes job/sub statuses even at zero
+    # remaining. Skipped only when the job is already terminal and
+    # nothing was adopted (true no-op double-resume).
+    if job["status"] in _TERMINAL_JOB and not adopted_rows:
+        summary["continuation"] = "skipped_terminal"
+        _journal.record("recovery", "resume_noop", job_id=job_id,
+                        job_status=job["status"], generation=generation)
+    else:
+        sched = MeshSweepScheduler(store, params_store,
+                                   advisor_service=advisors)
+        result = sched.run_sweep(
+            job_id,
+            chips=int(chips or cfg.get("chips") or 0) or None,
+            trials_per_chip=int(trials_per_chip
+                                or cfg.get("trials_per_chip") or 2),
+            advisor_kind=cfg.get("advisor_kind", "gp"),
+            stop_event=stop_event,
+            generation=generation,
+            advisor_kwargs=cfg.get("advisor_kwargs") or None,
+        )
+        summary["continuation"] = result.status
+
+    wal.close()
+    summary["wall_s"] = round(time.monotonic() - t0, 3)
+    _journal.record("recovery", "resume_finished", job_id=job_id,
+                    **{k: v for k, v in summary.items()
+                       if k not in ("job_id", "reconcile")})
+    events.emit("sweep_resumed", job_id=job_id, generation=generation,
+                adopted=summary["adopted"], salvaged=summary["salvaged"],
+                restarted=summary["restarted"],
+                continuation=summary["continuation"])
+    return summary

@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional
 from rafiki_tpu.constants import (
     InferenceJobStatus,
     ServiceStatus,
+    ServiceType,
     TrainJobStatus,
     TrialStatus,
 )
@@ -378,23 +379,28 @@ class MetaStore:
                  trial_id))
 
     def adopt_trial(self, trial_id: str, prev_service_id: Optional[str],
-                    service_id: str, worker_id: str) -> bool:
-        """Atomically take ownership of an orphaned RUNNING trial.
+                    service_id: str, worker_id: str,
+                    expected_status: Optional[str] = None) -> bool:
+        """Atomically take ownership of an orphaned trial.
 
         Compare-and-swap on (status, service_id): succeeds only if the
-        trial is still RUNNING and still bound to the service the sweep
-        observed, so (a) two concurrent recovery sweeps adopt each
-        orphan exactly once — the loser's UPDATE matches zero rows —
-        and (b) a zombie worker that finished the trial in the meantime
-        keeps its terminal status (no COMPLETED -> RUNNING regression).
+        trial still has the status the sweep observed (RUNNING by
+        default; ``resume_sweep`` also adopts QUEUED rows a crashed
+        supervisor claimed but never assigned) and is still bound to
+        the service the sweep observed, so (a) two concurrent recovery
+        sweeps adopt each orphan exactly once — the loser's UPDATE
+        matches zero rows — and (b) a zombie worker that finished the
+        trial in the meantime keeps its terminal status (no COMPLETED
+        -> RUNNING regression).
         """
+        expected = expected_status or TrialStatus.RUNNING.value
         with self._conn() as c:
             cur = c.execute(
                 "UPDATE trials SET status=?, error=NULL, stopped_at=NULL,"
                 " started_at=?, service_id=?, worker_id=?"
                 " WHERE id=? AND status=? AND service_id IS ?",
                 (TrialStatus.RUNNING.value, _now(), service_id, worker_id,
-                 trial_id, TrialStatus.RUNNING.value, prev_service_id))
+                 trial_id, expected, prev_service_id))
             return cur.rowcount > 0
 
     def mark_trial_as_terminated(self, trial_id: str) -> None:
@@ -538,6 +544,25 @@ class MetaStore:
 
     def get_service(self, service_id: str) -> Optional[dict]:
         return self._one("SELECT * FROM services WHERE id=?", (service_id,))
+
+    def get_jobs_with_dead_supervisor(self, stale_after_s: float) -> List[dict]:
+        """RUNNING train jobs whose sweep supervisor is provably gone:
+        at least one SUPERVISOR service row exists (the job IS a
+        supervised sweep — pre-WAL jobs without one are not flagged),
+        and none of them is live (non-terminal status AND a heartbeat
+        newer than the staleness cutoff). The resume reaper's detection
+        query (docs/recovery.md)."""
+        cutoff = _now() - float(stale_after_s)
+        return self._all(
+            "SELECT j.* FROM train_jobs j WHERE j.status=?"
+            " AND EXISTS (SELECT 1 FROM services s WHERE s.job_id=j.id"
+            "   AND s.service_type=?)"
+            " AND NOT EXISTS (SELECT 1 FROM services s WHERE s.job_id=j.id"
+            "   AND s.service_type=? AND s.status IN (?,?)"
+            "   AND s.heartbeat_at >= ?)",
+            (TrainJobStatus.RUNNING.value, ServiceType.SUPERVISOR.value,
+             ServiceType.SUPERVISOR.value, ServiceStatus.STARTED.value,
+             ServiceStatus.RUNNING.value, cutoff))
 
     def get_services_of_job(self, job_id: str) -> List[dict]:
         return self._all("SELECT * FROM services WHERE job_id=?", (job_id,))

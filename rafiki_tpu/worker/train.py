@@ -131,6 +131,11 @@ class TrainWorker:
         self.job_created_at = job_created_at or time.time()
         self.service_id = service_id
         self._stop = stop_event
+        # Sweep WAL handle (scheduler/wal.py), set by the mesh scheduler
+        # so the mid-pack backfill closure's budget claims are
+        # intent/commit-bracketed like the supervisor's up-front ones.
+        # None for standalone workers (no durable control plane to join).
+        self.wal = None
         self.trials_run = 0
         self._saver = _AsyncSaver(self) if async_persist else None
         # Mid-trial checkpoint cadence (epochs); 0/None = off. Env
@@ -692,14 +697,23 @@ class PackedTrialRunner:
                                 continue
                         except Exception:
                             continue
+                        wal = getattr(w, "wal", None)
+                        txn = None if wal is None else wal.intent(
+                            "backfill", sub_id=w.sub_id,
+                            knobs_hash=search_audit.knobs_hash(kn))
                         trial = w.store.create_trial(
                             w.sub_id, w.model_class.__name__, kn,
                             worker_id=w.worker_id,
                             shape_sig=knob_config_signature(knob_config, kn),
                             service_id=w.service_id, budget_max=budget_max)
                         if trial is None:
+                            if txn is not None:
+                                wal.commit(txn, "backfill", denied=True)
                             drained = True
                             break
+                        if txn is not None:
+                            wal.commit(txn, "backfill",
+                                       trial_id=trial["id"])
                         rows.append((trial["id"], kn))
                         events.emit("trial_started", trial_id=trial["id"],
                                     sub_job_id=w.sub_id,

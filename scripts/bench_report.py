@@ -101,6 +101,18 @@ STORE_METRICS = {
     "cas_dump_s": "lower",
 }
 
+#: Crash-recovery rounds (``--resume``): RESUME_r*.json artifacts from
+#: scripts/resume_smoke.py (docs/recovery.md). Recovery wall-clock is
+#: the headline — how long a SIGKILLed sweep takes to be adopted and
+#: driven to completion by a fresh process; duplicate_claims is the
+#: WAL-reconcile acceptance number and must stay at zero.
+RESUME_METRICS = {
+    "recovery_wall_s": "lower",
+    "trials_salvaged": "higher",
+    "trials_restarted": "lower",
+    "duplicate_claims": "lower",
+}
+
 #: Metrics where 0 is a legitimate measurement, not "did not run" —
 #: a clean serving round genuinely sheds nothing, a 1-worker round
 #: has zero fan-out cost, a perfectly calibrated twin has zero
@@ -108,7 +120,8 @@ STORE_METRICS = {
 #: zero regret. (Throughput-style metrics keep the strict v > 0
 #: rule: their zeros mean a dead backend.)
 ZERO_OK = {"shed_rate", "ensemble_fanout_cost_ms", "p50_err", "p99_err",
-           "regret", "advisor_lift", "dedup_ratio"}
+           "regret", "advisor_lift", "dedup_ratio",
+           "trials_salvaged", "trials_restarted", "duplicate_claims"}
 
 #: Metrics that are legitimately signed: a GP that *hurt* the sweep
 #: has negative lift, and that is a measurement the trend must carry,
@@ -155,7 +168,8 @@ def load_round(path: str) -> Dict[str, Any]:
             or "schema_version" in doc or "twin_schema_version" in doc
             or "sweep_schema_version" in doc
             or "scale_schema_version" in doc
-            or "store_schema_version" in doc):
+            or "store_schema_version" in doc
+            or "resume_schema_version" in doc):
         # A raw bench.py / bench_serving.py result saved directly, no
         # driver wrapper.
         out["payload"], out["source"] = doc, "raw"
@@ -236,6 +250,17 @@ def store_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     if not isinstance(payload, dict) or payload.get("error"):
         return {}
     return {k: payload.get(k) for k in STORE_METRICS
+            if payload.get(k) is not None}
+
+
+def resume_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """The crash-recovery block: resume_smoke artifacts carry the
+    headline keys at top level. A round whose resume never completed
+    stamps ``error`` and yields nothing — a job still down is no-data,
+    not an instant recovery."""
+    if not isinstance(payload, dict) or payload.get("error"):
+        return {}
+    return {k: payload.get(k) for k in RESUME_METRICS
             if payload.get(k) is not None}
 
 
@@ -331,15 +356,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--store", action="store_true",
                    help="trend params-store rounds (STORE_r*.json default "
                         "glob, txn/s + dedup higher, write frac lower)")
+    p.add_argument("--resume", action="store_true",
+                   help="trend crash-recovery rounds (RESUME_r*.json "
+                        "default glob, recovery_wall_s/restarts/duplicate "
+                        "claims lower, salvaged trials higher)")
     args = p.parse_args(argv)
 
     if sum((args.serving, args.twin, args.sweep, args.scale,
-            args.store)) > 1:
+            args.store, args.resume)) > 1:
         print(json.dumps(
-            {"error": "--serving, --twin, --sweep, --scale and --store "
-                      "are exclusive"}))
+            {"error": "--serving, --twin, --sweep, --scale, --store and "
+                      "--resume are exclusive"}))
         return 2
-    if args.scale:
+    if args.resume:
+        metric_set, headline_fn = RESUME_METRICS, resume_headline_of
+        pattern = "RESUME_r*.json"
+    elif args.scale:
         metric_set, headline_fn = SCALE_METRICS, scale_headline_of
         pattern = "SCALE_r*.json"
     elif args.store:
@@ -377,7 +409,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         "schema_version": REPORT_SCHEMA_VERSION,
         "tolerance": args.tolerance,
         "n_rounds": len(rounds),
-        "mode": ("scale" if args.scale
+        "mode": ("resume" if args.resume
+                 else "scale" if args.scale
                  else "store" if args.store
                  else "sweep" if args.sweep
                  else "twin" if args.twin

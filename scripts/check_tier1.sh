@@ -80,6 +80,13 @@ env JAX_PLATFORMS=cpu python scripts/sweep_smoke.py > /tmp/_sweep_smoke.json \
 # must gate both ways (docs/autoscale.md). ~5s.
 env JAX_PLATFORMS=cpu python scripts/autoscale_smoke.py > /tmp/_autoscale_smoke.json \
   || { echo "TIER1 AUTOSCALE SMOKE FAILED (see /tmp/_autoscale_smoke.json)"; exit 1; }
+# Crash-recovery smoke: a SIGKILLed sweep supervisor must be adopted
+# by a fresh process (WAL reconciled with zero duplicate claims, job
+# driven to COMPLETED, timeline reconstructible via `obs resume`), a
+# doctored WAL must refuse resume loudly, and bench_report --resume
+# must gate the RESUME_r* trend both ways (docs/recovery.md). ~15s.
+env JAX_PLATFORMS=cpu python scripts/resume_smoke.py > /tmp/_resume_smoke.json \
+  || { echo "TIER1 RESUME SMOKE FAILED (see /tmp/_resume_smoke.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

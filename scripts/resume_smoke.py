@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""Crash-recovery CI smoke: a SIGKILLed sweep must resume to
+completion in a fresh process, and a doctored WAL must refuse loudly
+(docs/recovery.md).
+
+Three phases, ~15s total:
+
+  1. **Kill + resume** — a 4-trial RandomAdvisor sweep run through
+     ``scheduler/sweep_proc.py`` with a ``supervisor.tick:kill`` fault
+     installed: the whole control plane dies by SIGKILL after its
+     warmup claims. A second ``sweep_proc resume`` process must adopt
+     the job, reconcile the WAL with zero duplicate claims, drive it
+     to COMPLETED with exactly budget-many trial rows, and ``obs
+     resume`` must reconstruct the timeline from the journals alone.
+     The measured recovery becomes the RESUME artifact (recovery
+     wall-clock, salvaged/restarted split, duplicate claims).
+  2. **Doctored WAL** — a WAL claiming a commit for a trial row that
+     does not exist (``committed_unclaimed``): resume must exit
+     non-zero naming the reconciliation failure instead of adopting a
+     job whose budget accounting is provably wrong.
+  3. **Report gate, both polarities** — ``bench_report --resume`` over
+     synthetic RESUME_r*.json rounds: an improving trend exits 0, a
+     collapsed round (recovery up, duplicate claims non-zero) exits 1,
+     and an error round reads as no-data, not an instant recovery.
+
+Output: one JSON object on stdout. Exit 0 when every assertion holds;
+1 otherwise — this is a CI gate (scripts/check_tier1.sh). ``--out
+PATH`` additionally writes phase 1's RESUME artifact to PATH.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESUME_SCHEMA_VERSION = 1
+BUDGET, CHIPS, TRIALS_PER_CHIP = 4, 2, 2
+SPEC = "seed=23;supervisor.tick:kill:after=30:times=1:match=g0"
+
+
+def _child_env(log_dir, chaos: bool):
+    from rafiki_tpu.chaos.scenarios import _sweep_proc_env
+
+    env = _sweep_proc_env(chaos=False)  # never inherit a caller's spec
+    env["RAFIKI_LOG_DIR"] = str(log_dir)
+    env["RAFIKI_SUPERVISOR_HEARTBEAT_S"] = "0.2"
+    env["RAFIKI_CHECKPOINT_EVERY"] = "1"
+    if chaos:
+        env["RAFIKI_CHAOS"] = SPEC
+    return env
+
+
+def phase_kill_resume(results):
+    from rafiki_tpu.chaos.scenarios import _make_job, _sweep_proc, _train_env
+    from rafiki_tpu.scheduler.wal import read_wal, reconcile, wal_path
+
+    tmp = Path(tempfile.mkdtemp(prefix="resume_smoke_"))
+    log_dir = tmp / "obs"
+    store, params, model = _train_env(tmp)
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": BUDGET})
+
+    killed, _ = _sweep_proc(
+        "run", store, params, job["id"], chips=CHIPS,
+        trials_per_chip=TRIALS_PER_CHIP, advisor="random",
+        env=_child_env(log_dir, chaos=True))
+    resumed, summary = _sweep_proc(
+        "resume", store, params, job["id"], chips=CHIPS,
+        trials_per_chip=TRIALS_PER_CHIP, stale_after_s=0.4,
+        env=_child_env(log_dir, chaos=False))
+
+    trials = store.get_trials_of_train_job(job["id"])
+    wal_recs = read_wal(wal_path(store.path, job["id"]))
+    rec = reconcile(wal_recs, trials)
+    dup = sum(1 for r in summary.get("reconcile", [])
+              for e in r.get("errors", []) if e["type"] == "duplicate_claim")
+
+    obs = subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.obs", "--dir", str(log_dir),
+         "resume", job["id"]],
+        env=_child_env(log_dir, chaos=False), capture_output=True,
+        text=True, timeout=60)
+
+    ph = {
+        "killed_rc": killed.returncode,
+        "resume_rc": resumed.returncode,
+        "resume_mode": summary.get("mode"),
+        "adopted": summary.get("adopted"),
+        "job_status": summary.get("status"),
+        "trial_rows": len(trials),
+        "all_completed": all(t["status"] == "COMPLETED" for t in trials),
+        "wal_reconciles": rec.ok,
+        "duplicate_claims": dup,
+        "obs_resume_rc": obs.returncode,
+        "obs_resume_reconstructs": "resumed:" in obs.stdout,
+        "ok": False,
+    }
+    ph["ok"] = (ph["killed_rc"] == -9 and ph["resume_rc"] == 0
+                and ph["resume_mode"] == "wal"
+                and (ph["adopted"] or 0) > 0
+                and ph["job_status"] == "COMPLETED"
+                and ph["trial_rows"] == BUDGET and ph["all_completed"]
+                and ph["wal_reconciles"] and dup == 0
+                and ph["obs_resume_rc"] == 0
+                and ph["obs_resume_reconstructs"])
+    if not ph["ok"]:
+        ph["killed_stderr"] = killed.stderr[-300:]
+        ph["resume_stderr"] = resumed.stderr[-300:]
+        ph["reconcile_errors"] = rec.errors
+    results["kill_resume"] = ph
+    artifact = {
+        "resume_schema_version": RESUME_SCHEMA_VERSION,
+        "recovery_wall_s": summary.get("wall_s"),
+        "trials_salvaged": summary.get("salvaged"),
+        "trials_restarted": summary.get("restarted"),
+        "duplicate_claims": dup,
+        "detail": {"budget": BUDGET, "chips": CHIPS,
+                   "adopted": summary.get("adopted"),
+                   "generation": summary.get("generation"),
+                   "spec": SPEC},
+    }
+    if not ph["ok"]:
+        artifact["error"] = "kill/resume phase failed"
+    return ph["ok"], artifact
+
+
+def phase_doctored(results):
+    """A WAL that commits a budget claim for a trial row the store has
+    never seen: adopting anyway would compound the damage, so resume
+    must refuse with the failure named."""
+    from rafiki_tpu.chaos.scenarios import _make_job, _sweep_proc, _train_env
+    from rafiki_tpu.constants import TrainJobStatus
+    from rafiki_tpu.scheduler.wal import SweepWal, wal_path
+
+    tmp = Path(tempfile.mkdtemp(prefix="resume_smoke_doctored_"))
+    store, params, model = _train_env(tmp)
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": BUDGET})
+    store.update_train_job_status(job["id"], TrainJobStatus.RUNNING.value)
+    wal = SweepWal(wal_path(store.path, job["id"]))
+    wal.note("sweep_config", advisor_kind="random", chips=CHIPS,
+             trials_per_chip=TRIALS_PER_CHIP)
+    txn = wal.intent("budget_claim", knobs_hash="h")
+    wal.commit(txn, "budget_claim", trial_id="ghost")
+    wal.close()
+
+    proc, _ = _sweep_proc(
+        "resume", store, params, job["id"], chips=CHIPS,
+        trials_per_chip=TRIALS_PER_CHIP, stale_after_s=0.4,
+        env=_child_env(tmp / "obs", chaos=False))
+    ph = {
+        "rc": proc.returncode,
+        "refuses": proc.returncode == 1,
+        "names_failure": "committed_unclaimed" in proc.stderr,
+        "ok": proc.returncode == 1 and "committed_unclaimed" in proc.stderr,
+    }
+    if not ph["ok"]:
+        ph["stderr"] = proc.stderr[-400:]
+    results["doctored"] = ph
+    return ph["ok"]
+
+
+def phase_report_gate(results, artifact):
+    """bench_report --resume over synthetic rounds seeded from the real
+    r01 artifact, both polarities."""
+    td = tempfile.mkdtemp(prefix="resume_rounds_")
+
+    def _round(n, doc):
+        path = os.path.join(td, f"RESUME_r{n:02d}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+    def _run(paths):
+        return subprocess.run(
+            [sys.executable, "scripts/bench_report.py", "--resume", *paths],
+            capture_output=True, text=True, env=dict(os.environ), cwd=REPO,
+            timeout=60)
+
+    improving = [
+        _round(1, dict(artifact, recovery_wall_s=12.0)),
+        _round(2, dict(artifact, recovery_wall_s=10.5)),
+        _round(3, {"resume_schema_version": RESUME_SCHEMA_VERSION,
+                   "error": "resume never completed"}),
+        _round(4, dict(artifact, recovery_wall_s=9.8)),
+    ]
+    ok_run = _run(improving)
+    regressed = improving + [
+        _round(5, dict(artifact, recovery_wall_s=40.0, duplicate_claims=2))]
+    bad_run = _run(regressed)
+    try:
+        ok_doc = json.loads(ok_run.stdout)
+        bad_doc = json.loads(bad_run.stdout)
+    except ValueError:
+        ok_doc, bad_doc = {}, {}
+    error_round_has_data = any(
+        r.get("has_data") for r in ok_doc.get("rounds", [])
+        if str(r.get("round", "")).endswith("r03.json"))
+    ph = {
+        "ok_rc": ok_run.returncode,
+        "ok_verdict": ok_doc.get("verdict"),
+        "regressed_rc": bad_run.returncode,
+        "regressed_metrics": bad_doc.get("regressed"),
+        "error_round_counted": error_round_has_data,
+        "ok": (ok_run.returncode == 0 and ok_doc.get("verdict") == "ok"
+               and bad_run.returncode == 1
+               and "recovery_wall_s" in (bad_doc.get("regressed") or [])
+               and "duplicate_claims" in (bad_doc.get("regressed") or [])
+               and not error_round_has_data),
+    }
+    if not ph["ok"]:
+        ph["ok_stderr"] = ok_run.stderr[-300:]
+        ph["regressed_stderr"] = bad_run.stderr[-300:]
+    results["report_gate"] = ph
+    return ph["ok"]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="scripts/resume_smoke.py")
+    ap.add_argument("--out", help="also write the RESUME artifact here")
+    args = ap.parse_args()
+
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()  # pin the platform before the scenario helpers
+    # pull in jax: off-TPU the run must not hang in backend init (RF001).
+
+    results = {}
+    ok, artifact = phase_kill_resume(results)
+    if ok:
+        ok = phase_doctored(results) and ok
+    if ok:
+        ok = phase_report_gate(results, artifact) and ok
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1)
+    results["ok"] = ok
+    print(json.dumps(results))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,7 +30,7 @@ def _analyze_snippet(tmp_path, source, name="snippet.py", select=None):
 def test_all_builtin_checkers_registered():
     assert {"RF001", "RF002", "RF003", "RF004", "RF005", "RF006",
             "RF007", "RF008", "RF009", "RF010", "RF011",
-            "RF012"} <= set(REGISTRY)
+            "RF012", "RF013"} <= set(REGISTRY)
 
 
 # ---------------------------------------------------------------------------
@@ -981,4 +981,119 @@ def test_rf012_current_tree_is_clean():
     r = analyze_paths([os.path.join(REPO, "rafiki_tpu"),
                        os.path.join(REPO, "scripts")], select=["RF012"])
     mine = [f for f in r.unsuppressed if f.checker_id == "RF012"]
+    assert mine == [], [f"{f.path}:{f.line}" for f in mine]
+
+
+# ---------------------------------------------------------------------------
+# RF013 undurable-decision
+# ---------------------------------------------------------------------------
+
+
+def _scheduler_snippet(tmp_path, source, select=None):
+    """Write the snippet INSIDE a rafiki_tpu/scheduler/ package tree so
+    module_name_for resolves it into RF013's scope."""
+    sched = tmp_path / "rafiki_tpu" / "scheduler"
+    sched.mkdir(parents=True)
+    for d in (tmp_path / "rafiki_tpu", sched):
+        (d / "__init__.py").write_text("")
+    f = sched / "snippet.py"
+    f.write_text(textwrap.dedent(source))
+    return analyze_paths([str(f)], select=select)
+
+
+RF013_BAD = """
+    def claim_and_assign(store, runner, knobs):
+        trial = store.create_trial(knobs)
+        runner.tasks.put(("pack", [trial]))
+        runner.tasks.put(("resume", trial["id"]))
+    """
+
+
+def test_rf013_fires_on_undurable_mutations(tmp_path):
+    r = _scheduler_snippet(tmp_path, RF013_BAD)
+    found = [f for f in r.unsuppressed if f.checker_id == "RF013"]
+    assert len(found) == 3
+    assert all(f.severity == "error" for f in found)
+    assert "unresumable" in found[0].message
+
+
+def test_rf013_scoped_to_scheduler_package_only(tmp_path):
+    # The identical source OUTSIDE rafiki_tpu/scheduler/ is legal: the
+    # WAL contract binds the sweep control plane, not arbitrary code.
+    r = _analyze_snippet(tmp_path, RF013_BAD)
+    assert "RF013" not in _ids(r)
+
+
+def test_rf013_quiet_when_intent_precedes(tmp_path):
+    r = _scheduler_snippet(tmp_path, """
+        def claim(store, wal, runner, knobs):
+            txn = wal.intent("budget_claim", knobs_hash="h")
+            trial = store.create_trial(knobs)
+            wal.commit(txn, "budget_claim", trial_id=trial["id"])
+            runner.tasks.put(("pack", [trial]))
+        """)
+    assert "RF013" not in _ids(r)
+
+
+def test_rf013_guarded_wal_idiom_counts(tmp_path):
+    # The degraded no-WAL mode: the intent call is conditionally
+    # skipped at runtime but lexically present — recovery handles the
+    # missing log loudly; the static contract is satisfied.
+    r = _scheduler_snippet(tmp_path, """
+        def backfill(store, wal, knobs):
+            txn = None if wal is None else wal.intent("backfill")
+            return store.create_trial(knobs)
+        """)
+    assert "RF013" not in _ids(r)
+
+
+def test_rf013_mutation_before_intent_still_fires(tmp_path):
+    # Ordering matters: an intent AFTER the mutation logs nothing the
+    # reconciler can use for a crash in between.
+    r = _scheduler_snippet(tmp_path, """
+        def backwards(store, wal, knobs):
+            trial = store.create_trial(knobs)
+            wal.intent("budget_claim")
+            return trial
+        """)
+    found = [f for f in r.unsuppressed if f.checker_id == "RF013"]
+    assert len(found) == 1
+
+
+def test_rf013_nested_closure_is_own_scope(tmp_path):
+    # The enclosing function's intent does NOT cover a closure that
+    # mutates later, on its own schedule: the closure needs its own.
+    r = _scheduler_snippet(tmp_path, """
+        def outer(store, wal, knobs):
+            wal.intent("budget_claim")
+
+            def backfill():
+                return store.create_trial(knobs)
+            return backfill
+        """)
+    found = [f for f in r.unsuppressed if f.checker_id == "RF013"]
+    assert len(found) == 1
+
+
+def test_rf013_ignores_non_assignment_puts(tmp_path):
+    r = _scheduler_snippet(tmp_path, """
+        def drain(runner, q):
+            runner.tasks.put(("stop", None))
+            q.put("anything")
+        """)
+    assert "RF013" not in _ids(r)
+
+
+def test_rf013_justified_suppression_honored(tmp_path):
+    r = _scheduler_snippet(tmp_path, """
+        def fake_claim(store, knobs):
+            # lint: disable=RF013 — test double; prod path WALs in mesh
+            return store.create_trial(knobs)
+        """)
+    assert "RF013" not in _ids(r)
+
+
+def test_rf013_current_scheduler_is_clean():
+    r = analyze_paths([os.path.join(REPO, "rafiki_tpu")], select=["RF013"])
+    mine = [f for f in r.unsuppressed if f.checker_id == "RF013"]
     assert mine == [], [f"{f.path}:{f.line}" for f in mine]
