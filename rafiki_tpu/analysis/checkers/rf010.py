@@ -4,7 +4,9 @@ Digital-twin finding (PR 11, docs/twin.md): the twin's contract is
 that one seed reproduces a simulation bit-for-bit — the validation
 gate, the chaos pre-gate and the fleet search all hash event logs and
 diff reruns, so ONE ambient-entropy read anywhere in
-``rafiki_tpu/obs/twin/`` silently voids every downstream guarantee.
+``rafiki_tpu/obs/twin/`` — the serving twin AND the ``train/``
+subpackage (PR 16), whose sweep simulator makes the same bit-identical
+replay promise — silently voids every downstream guarantee.
 The failure is nasty precisely because it's invisible: the sim still
 runs, the numbers still look plausible, and the nondeterminism only
 surfaces as an unreproducible validation flake weeks later.

@@ -461,7 +461,8 @@ class JaxModel(BaseModel):
         packed = PackedTrainLoop(
             fns["init_fn"], fns["apply_eval"], fns["loss_fn"], fns["optimizer"],
             seeds=[m._seed for m in models], hypers=hypers,
-            program_key=fns["program_key"])
+            program_key=fns["program_key"],
+            packing_key=repr(keys[id(lead)]))
 
         histories: List[List[Dict[str, float]]] = [[] for _ in models]
         arch = (num_classes, tuple(input_shape))

@@ -45,7 +45,7 @@ _EXPORTS = {
     "TwinConfig": "engine", "simulate": "engine",
 }
 _LAZY_MODULES = ("calibration", "load", "engine", "whatif", "validate",
-                 "pregate", "cli")
+                 "pregate", "cli", "train")
 
 __all__ = [*_EXPORTS, *_LAZY_MODULES]
 

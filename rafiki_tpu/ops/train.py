@@ -890,9 +890,14 @@ class PackedTrainLoop:
     def __init__(self, init_fn, apply_fn, loss_fn, optimizer=None,
                  seeds: Optional[list] = None,
                  hypers: Optional[list] = None,
-                 program_key: Optional[Hashable] = None):
+                 program_key: Optional[Hashable] = None,
+                 packing_key: Optional[str] = None):
         if not seeds:
             raise ValueError("PackedTrainLoop needs at least one seed")
+        # The repr of the members' shared Model.packing_key — stamped
+        # onto every perf/step record so the train twin can bucket
+        # step-time calibration per (packing_key, k) (docs/twin.md).
+        self.packing_key = packing_key
         self.k = len(seeds)
         hypers = hypers if hypers is not None else [{} for _ in seeds]
         if len(hypers) != self.k:
@@ -1152,7 +1157,8 @@ class PackedTrainLoop:
         from rafiki_tpu.obs.perf import profiler, slo
 
         profiler.note_epoch(self._perf_key, dt, cold=cold,
-                            kind="packed", k=self.k)
+                            kind="packed", k=self.k,
+                            packing_key=self.packing_key)
         slo.maybe_tick()
 
     def evaluate(self, dataset, batch_size: int) -> np.ndarray:

@@ -353,6 +353,22 @@ class MeshSweepScheduler:
             )
         k = max(1, int(trials_per_chip))
 
+        # Twin placement consultation (docs/twin.md): with
+        # RAFIKI_TWIN_PLACEMENT set, ask the calibrated train twin for
+        # a pack/split recommendation at admission — BEFORE any budget
+        # slot is claimed. Advisory-only by contract: the answer is
+        # journaled as twin/placement and never changes this sweep;
+        # a missing/stale calibration records the error and moves on.
+        if os.environ.get("RAFIKI_TWIN_PLACEMENT"):
+            try:
+                from rafiki_tpu.obs.twin.train import placement as _placement
+
+                _placement.consult(job_id=job_id, chips=len(devices), k=k,
+                                   budget=budget)
+            except Exception as e:
+                _journal.record("twin", "placement", job_id=job_id,
+                                advisory=True, error=str(e))
+
         errors: List[str] = []
         subs = self.store.get_sub_train_jobs(job_id)
         if not subs:
@@ -487,13 +503,17 @@ class MeshSweepScheduler:
         wal = self._wal
         buckets: Dict[str, List[tuple]] = {}
         order: List[str] = []
+        bucket_epochs: Dict[str, Optional[int]] = {}
         for kn in proposals:
             try:
                 m = model_cls(**kn)
                 key = repr(m.packing_key(m._prepared_dataset(
                     job["train_dataset_uri"])))
+                epochs = int(getattr(m, "epochs", 0)) or None
             except Exception:
                 key = f"unpackable:{id(kn)}"  # its own singleton pack
+                epochs = None
+            bucket_epochs.setdefault(key, epochs)
             txn = wal.intent("budget_claim", sub_id=sub["id"],
                              knobs_hash=_knobs_hash(kn))
             trial = self.store.create_trial(
@@ -518,7 +538,7 @@ class MeshSweepScheduler:
                 assign[cursor % n_chips][b].append(row)
                 cursor += 1
         for r, per_bucket in zip(runners, assign):
-            for rows in per_bucket:
+            for b, rows in enumerate(per_bucket):
                 if rows:
                     txn = wal.intent("pack_assign", chip=r.index,
                                      trial_ids=[tid for tid, _kn in rows])
@@ -530,6 +550,17 @@ class MeshSweepScheduler:
                             worker_id=r.worker.worker_id)
                     r.tasks.put(("pack", rows))
                     wal.commit(txn, "pack_assign")
+                    # First-class pack-composition record: the train
+                    # twin's calibrator reads these directly instead of
+                    # inferring composition from the fill-ratio gauge
+                    # (docs/twin.md).
+                    _journal.record(
+                        "mesh", "pack_formed", job_id=job_id,
+                        chip=r.index, packing_key=order[b],
+                        k=len(rows), fill_ratio=round(len(rows) / float(k), 4),
+                        epochs=bucket_epochs.get(order[b]),
+                        trial_ids=[tid for tid, _kn in rows],
+                        knobs_hashes=[_knobs_hash(kn) for _tid, kn in rows])
         _journal.record("mesh", "sweep_started", job_id=job_id,
                         chips=n_chips, trials_per_chip=k,
                         n_trials=sum(len(v) for v in buckets.values()))

@@ -69,6 +69,16 @@ TWIN_METRICS = {
     "p99_err": "lower",
 }
 
+#: Train-twin-validation rounds (``--train-twin``): TRAINTWIN_r*.json
+#: artifacts from ``python -m rafiki_tpu.obs twin train validate --out``
+#: (docs/twin.md). Relative |predicted-measured|/measured on the sweep's
+#: trials/hour and wall clock — a creeping error trend means the sweep
+#: simulator has drifted from the scheduler it predicts.
+TRAIN_TWIN_METRICS = {
+    "tph_err": "lower",
+    "wall_err": "lower",
+}
+
 #: Sweep-anatomy rounds (``--sweep``): SWEEP_r*.json artifacts from
 #: ``python -m rafiki_tpu.obs sweep --out`` (docs/search_anatomy.md).
 #: Reconciliation-failed rounds stamp ``error`` and read as no-data —
@@ -120,6 +130,7 @@ RESUME_METRICS = {
 #: zero regret. (Throughput-style metrics keep the strict v > 0
 #: rule: their zeros mean a dead backend.)
 ZERO_OK = {"shed_rate", "ensemble_fanout_cost_ms", "p50_err", "p99_err",
+           "tph_err", "wall_err",
            "regret", "advisor_lift", "dedup_ratio",
            "trials_salvaged", "trials_restarted", "duplicate_claims"}
 
@@ -166,6 +177,7 @@ def load_round(path: str) -> Dict[str, Any]:
         return out
     if ("metric" in doc or "headline" in doc or "qps" in doc
             or "schema_version" in doc or "twin_schema_version" in doc
+            or "train_twin_schema_version" in doc
             or "sweep_schema_version" in doc
             or "scale_schema_version" in doc
             or "store_schema_version" in doc
@@ -220,6 +232,18 @@ def twin_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     if not isinstance(payload, dict) or payload.get("error"):
         return {}
     return {k: payload.get(k) for k in TWIN_METRICS
+            if payload.get(k) is not None}
+
+
+def train_twin_headline_of(payload: Optional[Dict[str, Any]]
+                           ) -> Dict[str, Any]:
+    """The train-twin-error block: ``twin train validate`` artifacts
+    carry tph_err/wall_err at top level. Error rounds (journals
+    missing, too few trials captured) yield nothing — a round that
+    never validated is no-data, not a perfect score."""
+    if not isinstance(payload, dict) or payload.get("error"):
+        return {}
+    return {k: payload.get(k) for k in TRAIN_TWIN_METRICS
             if payload.get(k) is not None}
 
 
@@ -346,6 +370,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--twin", action="store_true",
                    help="trend twin-validation rounds (TWIN_r*.json "
                         "default glob, p50_err/p99_err lower-better)")
+    p.add_argument("--train-twin", action="store_true",
+                   help="trend train-twin-validation rounds "
+                        "(TRAINTWIN_r*.json default glob, "
+                        "tph_err/wall_err lower-better)")
     p.add_argument("--sweep", action="store_true",
                    help="trend sweep-anatomy rounds (SWEEP_r*.json "
                         "default glob, trials-per-hour/best-score higher, "
@@ -362,11 +390,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "claims lower, salvaged trials higher)")
     args = p.parse_args(argv)
 
-    if sum((args.serving, args.twin, args.sweep, args.scale,
-            args.store, args.resume)) > 1:
+    if sum((args.serving, args.twin, args.train_twin, args.sweep,
+            args.scale, args.store, args.resume)) > 1:
         print(json.dumps(
-            {"error": "--serving, --twin, --sweep, --scale, --store and "
-                      "--resume are exclusive"}))
+            {"error": "--serving, --twin, --train-twin, --sweep, --scale, "
+                      "--store and --resume are exclusive"}))
         return 2
     if args.resume:
         metric_set, headline_fn = RESUME_METRICS, resume_headline_of
@@ -380,6 +408,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.sweep:
         metric_set, headline_fn = SWEEP_METRICS, sweep_headline_of
         pattern = "SWEEP_r*.json"
+    elif args.train_twin:
+        metric_set, headline_fn = TRAIN_TWIN_METRICS, train_twin_headline_of
+        pattern = "TRAINTWIN_r*.json"
     elif args.twin:
         metric_set, headline_fn = TWIN_METRICS, twin_headline_of
         pattern = "TWIN_r*.json"
@@ -413,6 +444,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                  else "scale" if args.scale
                  else "store" if args.store
                  else "sweep" if args.sweep
+                 else "train-twin" if args.train_twin
                  else "twin" if args.twin
                  else "serving" if args.serving else "training"),
         "rounds": [{"round": r["round"], "rc": r["rc"],

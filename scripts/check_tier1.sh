@@ -87,6 +87,15 @@ env JAX_PLATFORMS=cpu python scripts/autoscale_smoke.py > /tmp/_autoscale_smoke.
 # must gate the RESUME_r* trend both ways (docs/recovery.md). ~15s.
 env JAX_PLATFORMS=cpu python scripts/resume_smoke.py > /tmp/_resume_smoke.json \
   || { echo "TIER1 RESUME SMOKE FAILED (see /tmp/_resume_smoke.json)"; exit 1; }
+# Train-twin smoke: capture a real seeded mini mesh sweep, calibrate
+# the train bundle BOTH ways (real capture passes, an empty dir fails
+# naming perf/step + mesh/pack_formed), validate predicted-vs-measured
+# trials/hour BOTH ways (correct calibration passes, a doctored epoch
+# scale fails), sweep a chips x pack grid byte-identically from one
+# seed, and gate the TRAINTWIN_r* error trend both ways
+# (docs/twin.md). ~30s.
+env JAX_PLATFORMS=cpu python scripts/train_twin_smoke.py > /tmp/_train_twin_smoke.json \
+  || { echo "TIER1 TRAIN TWIN SMOKE FAILED (see /tmp/_train_twin_smoke.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

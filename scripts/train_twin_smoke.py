@@ -1,0 +1,299 @@
+#!/usr/bin/env python
+"""Train-twin CI smoke: capture a real mesh sweep, calibrate, validate
+both polarities, sweep deterministically, gate the TRAINTWIN_r* trend
+both ways (docs/twin.md).
+
+Five phases, real subprocesses throughout:
+
+  1. **Capture** — ``train_twin_smoke.py --capture DIR`` in a child
+     process: a real ``MeshSweepScheduler.run_sweep`` (2 virtual chips
+     x k=2 packed trials, one ``propose_batch(4)`` draft) with
+     ``RAFIKI_LOG_DIR`` pointed at a fresh directory, so the sweep
+     plane journals ``mesh/pack_formed`` and packing-key-stamped
+     ``perf/step`` records — the train twin's two required kinds.
+  2. **Calibrate, both polarities** — ``twin_calibrate --train`` must
+     write a versioned train bundle from the capture (exit 0), and
+     must exit 2 on an empty dir naming BOTH missing record kinds
+     (perf/step, mesh/pack_formed) in one message.
+  3. **Validate, both polarities** — ``obs twin train validate``
+     replaying the captured packs must land predicted-vs-measured
+     trials/hour and wall inside tolerance (exit 0); with ``--scale
+     step=2.0 --scale compile=2.0`` the same gate must FAIL (exit 1).
+     (The mini-sweep's epochs are compile-dominated at CI scale, so
+     the doctored polarity scales both epoch segments; the pure 2x
+     step-time polarity is pinned by tests/test_train_twin.py on
+     synthetic journals where the step cost dominates.)
+  4. **Deterministic sweep** — ``obs twin train sweep`` over a
+     chips x pack grid, run twice with one seed, must emit
+     byte-identical JSON, and every row must carry its event-log
+     fingerprint.
+  5. **Report gate, both polarities** — ``bench_report --train-twin``
+     over synthetic TRAINTWIN_r*.json rounds: an improving error trend
+     exits 0, a regressed round (model drift) exits 1, and an
+     error-payload round reads as no-data, not a perfect score.
+
+Output: one JSON object on stdout. Exit 0 when every assertion holds;
+1 otherwise — this is a CI gate (scripts/check_tier1.sh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SEED = "7"
+
+
+def _run(cmd, env=None, timeout=600):
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          env=full_env, timeout=timeout, cwd=REPO)
+
+
+def _twin(log_dir, *verb_args):
+    return _run([sys.executable, "-m", "rafiki_tpu.obs", "--dir", log_dir,
+                 "--json", "twin", "train", *verb_args])
+
+
+def capture(log_dir: str) -> int:
+    """Child-process mode: run the real mini mesh sweep that journals
+    the train twin's calibration kinds under ``log_dir``."""
+    from rafiki_tpu.utils.backend import (ensure_host_device_count,
+                                          honor_env_platform)
+
+    honor_env_platform()
+    ensure_host_device_count(8)
+
+    # Spawned chip workers inherit RAFIKI_LOG_DIR; the scheduler's own
+    # mesh/* records ride this process's journal.
+    os.environ["RAFIKI_LOG_DIR"] = log_dir
+    from rafiki_tpu.obs.journal import journal
+    journal.configure(log_dir, role="sweep")
+
+    from rafiki_tpu.chaos.scenarios import FF_SOURCE, TRAIN, VAL
+    from rafiki_tpu.scheduler import MeshSweepScheduler
+    from rafiki_tpu.store import MetaStore, ParamsStore
+
+    tmp = tempfile.mkdtemp(prefix="train_twin_cap_")
+    store = MetaStore(os.path.join(tmp, "meta.sqlite3"))
+    params = ParamsStore(os.path.join(tmp, "params"))
+    model = store.create_model("twinff", "IMAGE_CLASSIFICATION", None,
+                               FF_SOURCE, "ChaosFF")
+    job = store.create_train_job("traintwin", "IMAGE_CLASSIFICATION", None,
+                                 TRAIN, VAL, {"MODEL_TRIAL_COUNT": 4})
+    store.create_sub_train_job(job["id"], model["id"])
+    result = MeshSweepScheduler(store, params).run_sweep(
+        job["id"], chips=2, trials_per_chip=2, advisor_kind="random")
+    journal.close()
+    print(json.dumps({"status": result.status,
+                      "trials": len(result.best_trials or []),
+                      "errors": result.errors}))
+    return 0 if result.status == "COMPLETED" else 1
+
+
+def phase_capture(results):
+    log_dir = tempfile.mkdtemp(prefix="train_twin_smoke_")
+    r = _run([sys.executable, "scripts/train_twin_smoke.py",
+              "--capture", log_dir])
+    try:
+        report = json.loads(r.stdout.splitlines()[-1]) if r.stdout else {}
+    except ValueError:
+        report = {"unparseable_stdout": r.stdout[-400:]}
+    journals = [f for f in os.listdir(log_dir)
+                if f.startswith("journal-")] if os.path.isdir(log_dir) else []
+    ph = {"capture_rc": r.returncode,
+          "status": report.get("status"),
+          "journal_files": len(journals),
+          "ok": (r.returncode == 0 and report.get("status") == "COMPLETED"
+                 and bool(journals))}
+    if not ph["ok"]:
+        ph["capture_stderr"] = r.stderr[-400:]
+    results["capture"] = ph
+    return log_dir if ph["ok"] else None
+
+
+def phase_calibrate(results, log_dir):
+    bundle = os.path.join(tempfile.mkdtemp(prefix="train_twin_cal_"),
+                          "train_twin_cal.json")
+    pos = _run([sys.executable, "scripts/twin_calibrate.py", "--train",
+                log_dir, "-o", bundle, "--json"])
+    empty = tempfile.mkdtemp(prefix="train_twin_cal_empty_")
+    neg = _run([sys.executable, "scripts/twin_calibrate.py", "--train",
+                empty, "-o", os.path.join(empty, "x.json"), "--json"])
+    try:
+        pos_doc = json.loads(pos.stdout)
+    except ValueError:
+        pos_doc = {}
+    try:
+        neg_doc = json.loads(neg.stdout)
+    except ValueError:
+        neg_doc = {}
+    missing = neg_doc.get("missing") or []
+    ph = {
+        "calibrate_rc": pos.returncode,
+        "bundle_written": os.path.exists(bundle),
+        "packing_keys": pos_doc.get("packing_keys"),
+        "packs": pos_doc.get("packs"),
+        "empty_dir_rc": neg.returncode,
+        "empty_dir_missing": missing,
+        "ok": (pos.returncode == 0 and os.path.exists(bundle)
+               and (pos_doc.get("packs") or 0) >= 2
+               and neg.returncode == 2
+               and set(missing) == {"perf/step", "mesh/pack_formed"}),
+    }
+    if not ph["ok"]:
+        ph["calibrate_stderr"] = pos.stderr[-300:]
+        ph["empty_stderr"] = neg.stderr[-300:]
+    results["calibrate"] = ph
+    return bundle if ph["ok"] else None
+
+
+def phase_validate(results, log_dir):
+    good = _twin(log_dir, "validate", "--seed", SEED)
+    bad = _twin(log_dir, "validate", "--seed", SEED,
+                "--scale", "step=2.0", "--scale", "compile=2.0")
+    try:
+        good_doc = json.loads(good.stdout)
+    except ValueError:
+        good_doc = {}
+    try:
+        bad_doc = json.loads(bad.stdout)
+    except ValueError:
+        bad_doc = {}
+    ph = {
+        "good_rc": good.returncode,
+        "good_tph_err": good_doc.get("tph_err"),
+        "good_wall_err": good_doc.get("wall_err"),
+        "tolerance": good_doc.get("tolerance"),
+        "miscal_rc": bad.returncode,
+        "miscal_wall_err": bad_doc.get("wall_err"),
+        "ok": (good.returncode == 0 and good_doc.get("ok") is True
+               and bad.returncode == 1 and bad_doc.get("ok") is False),
+    }
+    if not ph["ok"]:
+        ph["good_stderr"] = good.stderr[-300:]
+        ph["miscal_stderr"] = bad.stderr[-300:]
+    results["validate"] = ph
+    return good_doc if ph["ok"] else None
+
+
+def phase_sweep(results, log_dir):
+    args = ("sweep", "--seed", SEED, "--grid", "chips=1,2",
+            "--grid", "pack=1,2", "--best-k", "--split")
+    a = _twin(log_dir, *args)
+    b = _twin(log_dir, *args)
+    try:
+        doc = json.loads(a.stdout)
+    except ValueError:
+        doc = {}
+    rows = doc.get("rows") or []
+    ph = {
+        "rc": a.returncode,
+        "rows": len(rows),
+        "deterministic": a.stdout == b.stdout and a.returncode == 0,
+        "fingerprinted": bool(rows) and all(
+            r.get("event_log_sha1") for r in rows),
+        "best_k_keys": len(doc.get("best_k") or {}),
+        "split_best": (doc.get("split") or {}).get("best"),
+        "ok": False,
+    }
+    ph["ok"] = (ph["rc"] == 0 and ph["rows"] == 4 and ph["deterministic"]
+                and ph["fingerprinted"] and ph["best_k_keys"] >= 1
+                and ph["split_best"] is not None)
+    if not ph["ok"]:
+        ph["stderr"] = a.stderr[-300:]
+    results["sweep"] = ph
+    return ph["ok"]
+
+
+def phase_report_gate(results, good_doc):
+    """bench_report --train-twin over synthetic rounds, both
+    polarities. Round artifacts reuse the real validate doc with
+    doctored errors so the trend exercises the actual schema."""
+    td = tempfile.mkdtemp(prefix="train_twin_rounds_")
+
+    def _round(n, doc):
+        path = os.path.join(td, f"TRAINTWIN_r{n:02d}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+    base = dict(good_doc)
+    improving = [
+        _round(1, dict(base, tph_err=0.20, wall_err=0.22)),
+        _round(2, dict(base, tph_err=0.10, wall_err=0.12)),
+        _round(3, {"error": "no sweep captured this round"}),
+        _round(4, dict(base, tph_err=0.08, wall_err=0.10)),
+    ]
+    ok_run = _run([sys.executable, "scripts/bench_report.py",
+                   "--train-twin", *improving])
+    regressed = improving + [
+        _round(5, dict(base, tph_err=0.45, wall_err=0.50))]
+    bad_run = _run([sys.executable, "scripts/bench_report.py",
+                    "--train-twin", *regressed])
+    try:
+        ok_doc = json.loads(ok_run.stdout)
+        bad_doc = json.loads(bad_run.stdout)
+    except ValueError:
+        ok_doc, bad_doc = {}, {}
+    error_round_has_data = any(
+        r.get("has_data") for r in ok_doc.get("rounds", [])
+        if str(r.get("round", "")).endswith("r03.json"))
+    ph = {
+        "ok_rc": ok_run.returncode,
+        "ok_verdict": ok_doc.get("verdict"),
+        "mode": ok_doc.get("mode"),
+        "regressed_rc": bad_run.returncode,
+        "regressed_metrics": bad_doc.get("regressed"),
+        "error_round_counted": error_round_has_data,
+        "ok": (ok_run.returncode == 0 and ok_doc.get("verdict") == "ok"
+               and ok_doc.get("mode") == "train-twin"
+               and bad_run.returncode == 1
+               and "tph_err" in (bad_doc.get("regressed") or [])
+               and not error_round_has_data),
+    }
+    if not ph["ok"]:
+        ph["ok_stderr"] = ok_run.stderr[-300:]
+        ph["regressed_stderr"] = bad_run.stderr[-300:]
+    results["report_gate"] = ph
+    return ph["ok"]
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(prog="scripts/train_twin_smoke.py")
+    p.add_argument("--capture", metavar="DIR", default=None,
+                   help="child mode: run the mini mesh sweep journaling "
+                        "into DIR, then exit")
+    args = p.parse_args()
+    if args.capture:
+        return capture(args.capture)
+
+    results = {}
+    log_dir = phase_capture(results)
+    ok = log_dir is not None
+    good_doc = None
+    if ok:
+        ok = phase_calibrate(results, log_dir) is not None
+    if ok:
+        good_doc = phase_validate(results, log_dir)
+        ok = good_doc is not None
+    if ok:
+        ok = phase_sweep(results, log_dir) and ok
+    if ok and good_doc:
+        ok = phase_report_gate(results, good_doc) and ok
+    results["ok"] = ok
+    print(json.dumps(results))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

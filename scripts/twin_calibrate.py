@@ -10,6 +10,12 @@ sample distributions (``serving/hops``), the live gateway knobs
 ``calibration_version``-stamped JSON the twin CLI and tests load
 byte-reproducibly.
 
+With ``--train`` the TRAIN twin's bundle is extracted instead:
+per-(packing_key, k) epoch samples (``perf/step``), the captured pack
+placement (``mesh/pack_formed``) and sweep shape, fitted epoch
+overhead, and cost rows (docs/twin.md). The usual fix for a missing-
+kinds failure there is ``scripts/train_twin_smoke.py --capture DIR``.
+
 Fails LOUDLY (exit 2) listing every missing record kind rather than
 defaulting anything: a twin calibrated on air predicts air. The usual
 fix is re-running the workload (e.g. ``scripts/bench_serving.py
@@ -45,7 +51,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="bundle path (default twin_cal.json)")
     p.add_argument("--json", action="store_true",
                    help="emit the summary as JSON instead of prose")
+    p.add_argument("--train", action="store_true",
+                   help="extract the TRAIN twin's bundle (perf/step + "
+                        "mesh/pack_formed) instead of the serving one")
     args = p.parse_args(argv)
+
+    if args.train:
+        return _main_train(args)
 
     try:
         cal = Calibration.from_journal_dir(args.log_dir)
@@ -78,6 +90,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"wrote {args.out}: v{cal.version} bundle from "
               f"{cal.source} — {cal.workers} worker(s), "
               f"{summary['cost_rows']} cost row(s), samples [{segs}]")
+    return 0
+
+
+def _main_train(args) -> int:
+    from rafiki_tpu.obs.twin.train.calibration import (TrainCalibration,
+                                                       TrainCalibrationError)
+    try:
+        cal = TrainCalibration.from_journal_dir(args.log_dir)
+    except TrainCalibrationError as e:
+        if args.json:
+            print(json.dumps({"error": str(e), "missing": e.missing,
+                              "source": e.source}))
+        else:
+            print(f"twin_calibrate: {e}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"twin_calibrate: cannot read {args.log_dir}: {e}",
+              file=sys.stderr)
+        return 2
+
+    cal.save(args.out)
+    summary = {
+        "out": args.out,
+        "train_calibration_version": cal.version,
+        "source": cal.source,
+        "packing_keys": len(cal.packing_keys()),
+        "packs": len(cal.packs),
+        "sweep": cal.sweep,
+        "epoch_overhead_s": round(cal.epoch_overhead_s, 6),
+        "cost_rows": len(cal.cost),
+    }
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(f"wrote {args.out}: v{cal.version} train bundle from "
+              f"{cal.source} — {summary['packing_keys']} packing key(s), "
+              f"{summary['packs']} pack(s), "
+              f"overhead {summary['epoch_overhead_s']}s/epoch, "
+              f"{summary['cost_rows']} cost row(s)")
     return 0
 
 

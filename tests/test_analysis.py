@@ -726,6 +726,29 @@ def test_rf010_quiet_on_seeded_streams(tmp_path):
     assert "RF010" not in _ids(r)
 
 
+def _train_twin_snippet(tmp_path, source, select=None):
+    """Same as _twin_snippet but one level deeper — the train twin
+    subpackage inherits the determinism contract verbatim."""
+    train = tmp_path / "rafiki_tpu" / "obs" / "twin" / "train"
+    train.mkdir(parents=True)
+    for d in (tmp_path / "rafiki_tpu", tmp_path / "rafiki_tpu" / "obs",
+              train.parent, train):
+        (d / "__init__.py").write_text("")
+    f = train / "snippet.py"
+    f.write_text(textwrap.dedent(source))
+    return analyze_paths([str(f)], select=select)
+
+
+def test_rf010_covers_train_subpackage(tmp_path):
+    r = _train_twin_snippet(tmp_path, RF010_BAD)
+    found = [f for f in r.unsuppressed if f.checker_id == "RF010"]
+    assert len(found) == 3
+    messages = " ".join(f.message for f in found)
+    assert "OS entropy" in messages
+    assert "GLOBAL random stream" in messages
+    assert "ambient clock" in messages
+
+
 def test_rf010_justified_suppression_honored(tmp_path):
     r = _twin_snippet(tmp_path, """
         import time
