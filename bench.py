@@ -297,14 +297,17 @@ def _scale(platform: str) -> dict:
     # good-config scores and well above the ~0.1 chance floor.
     common = dict(noise=0.35, flip=0.2, lift_trials=12, lift_warmup=4,
                   lift_seeds=3, platform=platform)
+    # One knob read, mode-specific fallbacks: RAFIKI_BENCH_TRIALS set
+    # overrides both scales; unset, cpu smokes at 3 and tpu runs 30.
+    env_trials = os.environ.get("RAFIKI_BENCH_TRIALS")
     if platform == "cpu":  # smoke run for tests: seconds, not minutes
         return dict(src=BENCH_MODEL_SRC_SMOKE, train_n=2048, eval_n=512,
-                    w=8, trials=int(os.environ.get("RAFIKI_BENCH_TRIALS", "3")),
+                    w=8, trials=int(env_trials) if env_trials else 3,
                     micro_steps=5, canon_train=2048, canon_eval=512,
                     micro=dict(depth=11, width=0.25, batch=64),
                     top1_target=0.30, **common)
     return dict(src=BENCH_MODEL_SRC, train_n=CANON_TRAIN, eval_n=CANON_EVAL,
-                w=32, trials=int(os.environ.get("RAFIKI_BENCH_TRIALS", "30")),
+                w=32, trials=int(env_trials) if env_trials else 30,
                 micro_steps=100, canon_train=CANON_TRAIN, canon_eval=CANON_EVAL,
                 micro=dict(depth=16, width=1.0, batch=128),
                 top1_target=0.70, **common)

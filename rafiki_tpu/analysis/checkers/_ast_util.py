@@ -7,6 +7,15 @@ import ast
 from typing import Dict, Iterator, Optional, Set
 
 
+class LineNode:
+    """Line-only stand-in for ``Checker.finding`` when a finding is
+    derived from a cross-file join rather than a node in hand."""
+
+    def __init__(self, line: int):
+        self.lineno = line
+        self.col_offset = 0
+
+
 def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
     parents: Dict[ast.AST, ast.AST] = {}
     for node in ast.walk(tree):

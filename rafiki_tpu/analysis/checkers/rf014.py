@@ -1,0 +1,95 @@
+"""RF014 journal-kind-contract.
+
+The journal is the only cross-process transcript this system has: the
+twin calibrators, sweep reconstruction, advisor rehydration, and chaos
+invariant checks all join on ``kind/name`` string pairs that nothing
+type-checks. A renamed kind fails *silently* — the writer keeps
+writing, the reader's filter matches nothing, and the downstream tool
+reports "no data" instead of "contract broken". (The twin calibrator
+grew its fail-loud ``REQUIRED_KINDS`` list for exactly this reason;
+RF014 generalizes that guard to every reader in the tree.)
+
+Two polarities, one whole-program join
+(:mod:`rafiki_tpu.analysis.contracts.journal`):
+
+* **unknown** (error, at the reader site) — a reader expects a
+  kind/name no writer emits. This is the loud side of a rename in
+  EITHER direction: rename the writer and the old reader expectation
+  dangles; rename the reader and the new expectation dangles. The
+  message names the kind and the closest writer key with its site, so
+  the rename is diagnosable from the finding alone.
+* **unread** (warning, at the writer site) — a kind/name is written
+  but no reader consumes it by pair, by kind-wholesale filter, or (for
+  dynamic-name writers) by kind. Write-only forensic streams are
+  legitimate — suppress with a why naming the out-of-band consumer.
+
+Readers over record streams that are NOT the journal (a CLI's JSON
+output, a metastore row) are indistinguishable statically — suppress
+at the reader site stating the actual source.
+"""
+
+from __future__ import annotations
+
+import difflib
+from typing import Dict, Iterable, List, Set, Tuple
+
+from rafiki_tpu.analysis.checkers._ast_util import LineNode
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+from rafiki_tpu.analysis.contracts import journal_contracts
+from rafiki_tpu.analysis.contracts.journal import (
+    unknown_reader_keys, unread_writer_keys)
+
+
+def _closest(key: str, candidates: Dict[str, list]) -> str:
+    match = difflib.get_close_matches(key, sorted(candidates), n=1,
+                                      cutoff=0.6)
+    if not match:
+        return ""
+    sites = candidates[match[0]]
+    first = min(sites, key=lambda s: (s.path, s.line))
+    return (f"; closest existing key is '{match[0]}' "
+            f"({first.path}:{first.line}) — renamed?")
+
+
+@register
+class JournalKindContract(Checker):
+    id = "RF014"
+    name = "journal-kind-contract"
+    severity = "error"
+    rationale = ("a renamed journal kind fails silently: the writer "
+                 "keeps writing, the reader matches nothing")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        jc = journal_contracts(ctx.project)
+        unknown: Set[str] = set(unknown_reader_keys(jc))
+        unread: Set[str] = set(unread_writer_keys(jc))
+        writer_pairs = jc.writer_pairs()
+        reader_pairs = jc.reader_pairs()
+        seen: Set[Tuple[int, str]] = set()
+        out: List[Finding] = []
+        for r in jc.readers:
+            if r.path != ctx.path or r.key not in unknown:
+                continue
+            if (r.line, r.key) in seen:
+                continue
+            seen.add((r.line, r.key))
+            out.append(self.finding(
+                ctx, LineNode(r.line),
+                f"reader expects journal kind '{r.key}' "
+                f"({r.source}) but no writer emits it"
+                + _closest(r.key, writer_pairs)))
+        for w in jc.writers:
+            key = w.key
+            if w.path != ctx.path or key not in unread:
+                continue
+            if (w.line, key) in seen:
+                continue
+            seen.add((w.line, key))
+            out.append(self.finding(
+                ctx, LineNode(w.line),
+                f"journal kind '{key}' is written here but no reader "
+                f"consumes it" + _closest(key, reader_pairs)
+                + " (add a reader, drop the writer, or suppress "
+                  "naming the out-of-band consumer)",
+                severity="warning"))
+        return out

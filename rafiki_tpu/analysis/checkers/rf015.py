@@ -1,0 +1,51 @@
+"""RF015 reader-field-not-written.
+
+The companion to RF014 one level down: the kind/name pair matches, but
+the reader projects a *field* no writer site ever passes. The failure
+mode is quieter than a dangling kind — ``r.get("fill_ratio")`` just
+returns ``None`` and flows into arithmetic or a report as a hole (the
+twin calibrator's fill-ratio column went empty for two PRs this way;
+the records existed, the field had been renamed at the writer).
+
+Fires only when the joined writer field set is fully static: a writer
+with ``**kwargs`` (the audit/span/ledger shape) or a dynamic name
+marks the field set open and RF015 stays silent — soundness over
+coverage, per docs/static_analysis.md. Implicit record fields
+(``ts``/``pid``/``role``/``kind``/``name``/``trace_id``) are always
+written by ``Journal.record`` itself and never flagged.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from rafiki_tpu.analysis.checkers._ast_util import LineNode
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+from rafiki_tpu.analysis.contracts import journal_contracts
+from rafiki_tpu.analysis.contracts.journal import missing_reader_fields
+
+
+@register
+class ReaderFieldNotWritten(Checker):
+    id = "RF015"
+    name = "reader-field-not-written"
+    severity = "error"
+    rationale = ("a field read that no writer populates degrades to "
+                 "silent Nones, not an error")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        jc = journal_contracts(ctx.project)
+        out: List[Finding] = []
+        for r, missing in missing_reader_fields(jc):
+            if r.path != ctx.path:
+                continue
+            writers = [w for w in jc.writers if w.kind == r.kind
+                       and (r.name is None or w.name == r.name)]
+            first = min(writers, key=lambda w: (w.path, w.line))
+            out.append(self.finding(
+                ctx, LineNode(r.line),
+                f"reader of '{r.key}' expects field(s) "
+                f"{', '.join(repr(f) for f in missing)} that no writer "
+                f"emits (writer: {first.path}:{first.line} writes "
+                f"{sorted(first.fields)})"))
+        return out

@@ -1,0 +1,79 @@
+"""RF016 env-knob-contract.
+
+Every ``RAFIKI_*`` environment variable is a cross-process config
+channel with no schema. Two failure classes recur:
+
+* **default divergence** — the same knob read in two places with two
+  different constant defaults. Whichever process reads it first
+  "wins" its own default, and behavior depends on which code path ran
+  — set the knob and both agree, unset it and they silently differ.
+  Only distinct *constant* defaults count: a required read
+  (``os.environ["K"]``) or a computed default can't statically
+  disagree with anything. One finding per knob, anchored at its first
+  read in path order, listing every site and its default.
+* **unpropagated knob** — a subprocess spawned with an explicitly
+  constructed env dict (NOT ``dict(os.environ)``/``.copy()``, which
+  inherit everything) whose ``-m`` target transitively reads knobs the
+  dict never sets. The child silently falls back to defaults the
+  parent may have overridden. One finding per spawn site, listing the
+  missing knobs.
+
+Deliberately different defaults (a smoke that wants a bigger pack than
+the library fallback) suppress with a why stating the intent.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from rafiki_tpu.analysis.checkers._ast_util import LineNode
+from rafiki_tpu.analysis.core import (
+    Checker, Finding, ModuleContext, ProjectContext, register)
+from rafiki_tpu.analysis.contracts import env_contracts
+from rafiki_tpu.analysis.contracts.envknobs import knobs_in_closure
+
+
+@register
+class EnvKnobContract(Checker):
+    id = "RF016"
+    name = "env-knob-contract"
+    severity = "error"
+    rationale = ("same knob, different defaults: behavior depends on "
+                 "which process read it; unpropagated knobs silently "
+                 "reset in children")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        env = env_contracts(ctx.project)
+        out: List[Finding] = []
+        for knob, reads in sorted(env.divergent().items()):
+            anchor = reads[0]  # reads are (knob, path, line)-sorted
+            if anchor.path != ctx.path:
+                continue
+            sites = ", ".join(f"{r.path}:{r.line}={r.default}"
+                              for r in reads)
+            out.append(self.finding(
+                ctx, LineNode(anchor.line),
+                f"knob '{knob}' is read with "
+                f"{len({r.default for r in reads})} different constant "
+                f"defaults ({sites}) — unset, behavior depends on "
+                f"which code path ran"))
+        for s in env.spawns:
+            if (s.path != ctx.path or s.inherits_environ
+                    or s.target_module is None):
+                continue
+            child = knobs_in_closure(
+                ctx.project.modules,
+                ProjectContext._imported_module_names,
+                s.target_module, env)
+            missing = sorted(k for k in child if k not in s.explicit_keys)
+            if not missing:
+                continue
+            shown = ", ".join(missing[:6])
+            if len(missing) > 6:
+                shown += f", +{len(missing) - 6} more"
+            out.append(self.finding(
+                ctx, LineNode(s.line),
+                f"spawn of {s.target_module} passes an explicit env "
+                f"that omits knob(s) the child reads: {shown} — "
+                f"inherit os.environ or propagate them"))
+        return out

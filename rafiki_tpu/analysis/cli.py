@@ -4,6 +4,14 @@
 Exit code 0 when every finding is suppressed (with justification), 1
 when unsuppressed findings remain, 2 on usage/parse errors —
 scripts/check_lint.sh turns that into the tier-1 gate.
+
+``--contracts`` switches to contract-extraction mode: instead of
+findings it emits the whole-program contracts manifest (journal
+writer/reader joins, env-knob registry, telemetry names) as
+byte-deterministic JSON; with ``--docs`` it emits the generated
+docs/knobs.md instead. check_lint.sh diffs both against the committed
+copies (tests/data/contracts_manifest.json, docs/knobs.md), so
+contract drift fails the gate as a reviewable diff.
 """
 
 from __future__ import annotations
@@ -47,7 +55,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--show-suppressed", action="store_true",
                         help="include suppressed findings in text output")
     parser.add_argument("--list-checkers", action="store_true")
+    parser.add_argument("--contracts", action="store_true",
+                        help="emit the whole-program contracts manifest "
+                             "(deterministic JSON) instead of findings")
+    parser.add_argument("--docs", action="store_true",
+                        help="with --contracts: emit the generated "
+                             "docs/knobs.md instead of the manifest")
     args = parser.parse_args(argv)
+
+    if args.docs and not args.contracts:
+        print("--docs requires --contracts", file=sys.stderr)
+        return 2
+    if args.contracts:
+        from rafiki_tpu.analysis.contracts import generate_knobs_md
+        from rafiki_tpu.analysis.contracts.envknobs import extract_env
+        from rafiki_tpu.analysis.contracts.manifest import (
+            _load_modules, dump_manifest, manifest_for_paths)
+
+        paths = args.paths or DEFAULT_PATHS
+        if args.docs:
+            import os
+            env = extract_env(_load_modules(paths, root=os.getcwd()))
+            sys.stdout.write(generate_knobs_md(env))
+        else:
+            sys.stdout.write(dump_manifest(manifest_for_paths(paths)))
+        return 0
 
     load_builtin_checkers()
     if args.list_checkers:

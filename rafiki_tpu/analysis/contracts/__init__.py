@@ -1,0 +1,56 @@
+"""Whole-program contract extraction (docs/static_analysis.md).
+
+The per-file checkers (RF001–RF013) each encode a single-file failure
+class. The contracts layer is different in kind: it extracts every
+cross-process *contract surface* from the full analyzed tree —
+
+* **journal contracts** (:mod:`.journal`) — every
+  ``journal.record(kind, name, field=...)`` writer site joined against
+  reader-side expectations (kind/name filters in ``obs/`` readers, the
+  twin calibrators' ``REQUIRED_KINDS`` lists, ``search/reconstruct``,
+  ``advisor/rehydrate``, chaos reconstruction checks);
+* **env-knob registry** (:mod:`.envknobs`) — every ``RAFIKI_*`` read
+  with its statically-derivable default and parse type, plus subprocess
+  spawn sites and the env keys they propagate;
+* **telemetry-name registry** (:mod:`.telem`) — counter/gauge/histogram
+  names at ``inc``/``set_gauge``/``add_gauge``/``observe`` sites joined
+  against the prom golden and the docs/telemetry.md table.
+
+and joins them into one machine-readable **manifest**
+(:mod:`.manifest`). RF014–RF016 surface violations through the normal
+lint CLI; ``python -m rafiki_tpu.analysis --contracts`` emits the
+manifest, whose committed golden (tests/data/contracts_manifest.json)
+turns any contract drift into a reviewable diff.
+
+Extraction is memoized per analysis run via ``ProjectContext.fact`` so
+the three checkers share one walk of the tree.
+"""
+
+from __future__ import annotations
+
+from rafiki_tpu.analysis.contracts.envknobs import (  # noqa: F401
+    EnvContracts, KnobRead, SpawnSite, extract_env)
+from rafiki_tpu.analysis.contracts.journal import (  # noqa: F401
+    IMPLICIT_FIELDS, JournalContracts, ReaderSite, WriterSite,
+    extract_journal)
+from rafiki_tpu.analysis.contracts.knobdocs import (  # noqa: F401
+    KNOB_DOCS, generate_knobs_md)
+from rafiki_tpu.analysis.contracts.manifest import (  # noqa: F401
+    build_manifest, dump_manifest, manifest_for_paths)
+from rafiki_tpu.analysis.contracts.telem import (  # noqa: F401
+    TelemetryContracts, extract_telemetry)
+
+FACT_JOURNAL = "contracts.journal"
+FACT_ENV = "contracts.env"
+
+
+def journal_contracts(project) -> "JournalContracts":
+    """The run-wide journal contract surface, computed once."""
+    return project.fact(
+        FACT_JOURNAL, lambda p: extract_journal(p.modules.values()))
+
+
+def env_contracts(project) -> "EnvContracts":
+    """The run-wide env-knob registry, computed once."""
+    return project.fact(
+        FACT_ENV, lambda p: extract_env(p.modules.values()))

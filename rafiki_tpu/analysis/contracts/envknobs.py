@@ -1,0 +1,456 @@
+"""Env-knob registry extraction: every ``RAFIKI_*`` read, its default,
+its parse type, and the subprocess spawn sites that would (or would
+not) propagate it.
+
+A *read* is ``os.environ.get("RAFIKI_X", default)`` / ``os.getenv`` /
+``os.environ["RAFIKI_X"]``. The parse type is inferred from the
+immediately enclosing call (``int(...)``/``float(...)``/``Path(...)``);
+a non-constant default (``f"pw-{os.getpid()}"``) is recorded as dynamic
+and excluded from divergence checking — only two *constant* defaults
+can statically disagree.
+
+A *spawn site* is a ``subprocess.Popen``/``run``/``check_output`` call
+whose argv contains ``"-m", "<module>"``. Its env provenance is traced
+within the enclosing function: ``dict(os.environ)`` /
+``os.environ.copy()`` marks it inheriting (every knob rides along);
+otherwise the explicitly assigned keys (``env["K"] = ...``,
+``env.update({...})``) are the propagation set, and a knob read in the
+spawned module's import closure but missing from that set is an RF016
+unpropagated-knob violation.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from rafiki_tpu.analysis.checkers._ast_util import dotted_name, parent_map
+
+PREFIX = "RAFIKI_"
+
+_SPAWN_LEAVES = {"Popen", "run", "check_output", "check_call", "call"}
+
+
+@dataclass
+class KnobRead:
+    path: str
+    line: int
+    knob: str
+    default: Optional[str]       # repr of a constant default; None: none
+    dynamic_default: bool = False  # a default exists but isn't constant
+    required: bool = False       # subscript read: raises when unset
+    parse: str = "str"           # int | float | str | path | flag
+
+    def manifest_default(self) -> str:
+        if self.required:
+            return "<required>"
+        if self.dynamic_default:
+            return "<dynamic>"
+        return self.default if self.default is not None else "<none>"
+
+
+@dataclass
+class SpawnSite:
+    path: str
+    line: int
+    target_module: Optional[str]  # "-m" argv target, when constant
+    inherits_environ: bool
+    explicit_keys: Tuple[str, ...] = ()
+
+
+@dataclass
+class EnvContracts:
+    reads: List[KnobRead] = field(default_factory=list)
+    spawns: List[SpawnSite] = field(default_factory=list)
+
+    def by_knob(self) -> Dict[str, List[KnobRead]]:
+        out: Dict[str, List[KnobRead]] = {}
+        for r in self.reads:
+            out.setdefault(r.knob, []).append(r)
+        return out
+
+    def divergent(self) -> Dict[str, List[KnobRead]]:
+        """Knobs read with more than one distinct *constant* default."""
+        out: Dict[str, List[KnobRead]] = {}
+        for knob, reads in self.by_knob().items():
+            consts = [r for r in reads
+                      if r.default is not None and not r.dynamic_default
+                      and not r.required]
+            if len({r.default for r in consts}) > 1:
+                out[knob] = consts
+        return out
+
+
+# ---------------------------------------------------------------------------
+
+
+def _module_consts(tree: ast.Module) -> Dict[str, object]:
+    """Module-level ``NAME = <constant>`` bindings — the ``ENV_VAR =
+    "RAFIKI_CHAOS"`` indirection idiom resolves through these, for
+    both the knob name and the default."""
+    out: Dict[str, object] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _knob_name(node: Optional[ast.AST],
+               consts: Dict[str, object]) -> Optional[str]:
+    v: object = None
+    if isinstance(node, ast.Constant):
+        v = node.value
+    elif isinstance(node, ast.Name):
+        v = consts.get(node.id)
+    return v if isinstance(v, str) and v.startswith(PREFIX) else None
+
+
+def _env_read(node: ast.AST, consts: Dict[str, object]
+              ) -> Optional[Tuple[str, Optional[ast.AST], bool]]:
+    """``(knob, default_node, required)`` when ``node`` reads a
+    RAFIKI_* env var."""
+    if isinstance(node, ast.Call):
+        dn = dotted_name(node.func)
+        if dn.endswith("environ.get") or dn in ("os.getenv", "getenv"):
+            knob = _knob_name(node.args[0] if node.args else None, consts)
+            if knob is not None:
+                default = node.args[1] if len(node.args) > 1 else None
+                if default is None:
+                    for k in node.keywords:
+                        if k.arg == "default":
+                            default = k.value
+                return knob, default, False
+    elif isinstance(node, ast.Subscript):
+        if (dotted_name(node.value).endswith("environ")
+                and isinstance(node.ctx, ast.Load)):
+            knob = _knob_name(node.slice, consts)
+            if knob is not None:
+                return knob, None, True
+    return None
+
+
+_PARSE_LEAVES = {"int": "int", "float": "float", "Path": "path",
+                 "bool": "flag"}
+
+
+def _parse_type(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> str:
+    """Walk up a couple of wrapper levels looking for int()/float()/
+    Path(); ``.lower() in (...)`` membership marks a flag."""
+    cur, hops = node, 0
+    while cur in parents and hops < 4:
+        p = parents[cur]
+        if isinstance(p, ast.Call):
+            # p.func.attr (not dotted_name) so chains rooted at the env
+            # call itself — environ.get(...).lower() — still resolve
+            leaf = (p.func.attr if isinstance(p.func, ast.Attribute)
+                    else dotted_name(p.func).rsplit(".", 1)[-1])
+            if leaf in _PARSE_LEAVES and p.args and p.args[0] is cur:
+                return _PARSE_LEAVES[leaf]
+            if leaf == "lower":
+                cur, hops = p, hops + 1
+                continue
+        if (isinstance(p, ast.Compare) and len(p.ops) == 1
+                and isinstance(p.ops[0], (ast.In, ast.NotIn))):
+            return "flag"
+        if isinstance(p, (ast.BinOp, ast.BoolOp, ast.Compare,
+                          ast.Attribute)):
+            cur, hops = p, hops + 1
+            continue
+        break
+    return "str"
+
+
+def _default_repr(node: Optional[ast.AST], consts: Dict[str, object]
+                  ) -> Tuple[Optional[str], bool]:
+    """(constant repr, dynamic?) for a default expression; module-level
+    constants count as constant."""
+    if node is None:
+        return None, False
+    if isinstance(node, ast.Constant):
+        return repr(node.value), False
+    if isinstance(node, ast.Name) and node.id in consts:
+        return repr(consts[node.id]), False
+    return None, True
+
+
+# -- env-read helper functions ----------------------------------------------
+#
+# autoscale/health/perf wrap their reads in module-private helpers
+# (``_env_float("TICK_S", 1.0)`` with the prefix concatenated inside,
+# or ``_env_float(ENV_K, DEFAULT_K)`` with full-name constants). The
+# helper body names a *parameter* so the direct pass can't see the
+# knob; resolving constant-argument call sites recovers it — same
+# technique as journal helper predicates.
+
+
+@dataclass
+class _EnvHelper:
+    prefix: str                      # "" or the concatenated constant
+    has_default_param: bool          # 2nd parameter supplies the default
+    internal_default: Optional[str]  # env call's own constant default
+    parse: str
+
+
+def _helper_parse(fn: ast.FunctionDef) -> str:
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Compare) and len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.In, ast.NotIn))):
+            return "flag"
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            leaf = dotted_name(node.func).rsplit(".", 1)[-1]
+            if leaf in _PARSE_LEAVES:
+                return _PARSE_LEAVES[leaf]
+    return "str"
+
+
+def _env_helpers(tree: ast.Module, consts: Dict[str, object]
+                 ) -> Dict[str, _EnvHelper]:
+    out: Dict[str, _EnvHelper] = {}
+    for fn in tree.body:
+        if not isinstance(fn, ast.FunctionDef) or not fn.args.args:
+            continue
+        name_param = fn.args.args[0].arg
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if not (dn.endswith("environ.get")
+                    or dn in ("os.getenv", "getenv")):
+                continue
+            arg = node.args[0] if node.args else None
+            prefix: Optional[str] = None
+            if isinstance(arg, ast.Name) and arg.id == name_param:
+                prefix = ""
+            elif (isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add)
+                    and isinstance(arg.right, ast.Name)
+                    and arg.right.id == name_param):
+                left = arg.left
+                if (isinstance(left, ast.Constant)
+                        and isinstance(left.value, str)):
+                    prefix = left.value
+                elif (isinstance(left, ast.Name)
+                        and isinstance(consts.get(left.id), str)):
+                    prefix = str(consts[left.id])
+            if prefix is None:
+                continue
+            internal = None
+            if (len(node.args) > 1
+                    and isinstance(node.args[1], ast.Constant)
+                    and node.args[1].value not in (None, "")):
+                internal = repr(node.args[1].value)
+            out[fn.name] = _EnvHelper(
+                prefix=prefix,
+                has_default_param=len(fn.args.args) > 1,
+                internal_default=internal,
+                parse=_helper_parse(fn))
+            break
+    return out
+
+
+def _helper_read(node: ast.AST, helpers: Dict[str, _EnvHelper],
+                 consts: Dict[str, object]) -> Optional[KnobRead]:
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in helpers):
+        return None
+    h = helpers[node.func.id]
+    a0 = node.args[0] if node.args else None
+    name: Optional[str] = None
+    if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+        name = a0.value
+    elif isinstance(a0, ast.Name) and isinstance(consts.get(a0.id), str):
+        name = str(consts[a0.id])
+    if name is None:                 # dynamic name: degrade silently
+        return None
+    knob = h.prefix + name
+    if not knob.startswith(PREFIX):
+        return None
+    if h.has_default_param and len(node.args) > 1:
+        default, dynamic = _default_repr(node.args[1], consts)
+    elif h.internal_default is not None:
+        default, dynamic = h.internal_default, False
+    else:
+        default, dynamic = None, False
+    return KnobRead(path="", line=node.lineno, knob=knob, default=default,
+                    dynamic_default=dynamic, required=False, parse=h.parse)
+
+
+# -- spawn-site env provenance ----------------------------------------------
+
+
+def _argv_module(call: ast.Call) -> Optional[str]:
+    if not call.args or not isinstance(call.args[0], (ast.List, ast.Tuple)):
+        return None
+    elts = call.args[0].elts
+    for i, e in enumerate(elts[:-1]):
+        if (isinstance(e, ast.Constant) and e.value == "-m"
+                and isinstance(elts[i + 1], ast.Constant)
+                and isinstance(elts[i + 1].value, str)):
+            return elts[i + 1].value
+    return None
+
+
+def _env_provenance(fn_body: Sequence[ast.stmt], env_var: str
+                    ) -> Tuple[bool, Tuple[str, ...]]:
+    """(inherits_environ, explicit keys) for ``env_var`` assignments
+    within the enclosing function."""
+    inherits = False
+    keys: Set[str] = set()
+    for node in ast.walk(ast.Module(body=list(fn_body), type_ignores=[])):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == env_var:
+                    v = node.value
+                    dn = dotted_name(v.func) if isinstance(v, ast.Call) else ""
+                    if ((dn == "dict" and v.args
+                         and dotted_name(v.args[0]).endswith("environ"))
+                            or dn.endswith("environ.copy")):
+                        inherits = True
+                    elif isinstance(v, ast.Dict):
+                        keys.update(k.value for k in v.keys
+                                    if isinstance(k, ast.Constant)
+                                    and isinstance(k.value, str))
+                elif (isinstance(t, ast.Subscript)
+                      and isinstance(t.value, ast.Name)
+                      and t.value.id == env_var
+                      and isinstance(t.slice, ast.Constant)
+                      and isinstance(t.slice.value, str)):
+                    keys.add(t.slice.value)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "update"
+              and isinstance(node.func.value, ast.Name)
+              and node.func.value.id == env_var):
+            for a in node.args:
+                if isinstance(a, ast.Dict):
+                    keys.update(k.value for k in a.keys
+                                if isinstance(k, ast.Constant)
+                                and isinstance(k.value, str))
+    return inherits, tuple(sorted(keys))
+
+
+def _extract_spawns(path: str, tree: ast.Module,
+                    out: EnvContracts) -> None:
+    parents = parent_map(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        parts = dotted_name(node.func).split(".")
+        if parts[-1] not in _SPAWN_LEAVES:
+            continue
+        if parts[-1] != "Popen" and "subprocess" not in parts[:-1]:
+            continue  # bare run()/call() that isn't subprocess's
+        target = _argv_module(node)
+        if target is None:
+            continue
+        env_kw = next((k.value for k in node.keywords if k.arg == "env"),
+                      None)
+        if env_kw is None:
+            out.spawns.append(SpawnSite(path, node.lineno, target,
+                                        inherits_environ=True))
+            continue
+        dn = dotted_name(env_kw) if not isinstance(env_kw, ast.Call) else \
+            dotted_name(env_kw.func)
+        if (isinstance(env_kw, ast.Call)
+                and ((dn == "dict" and env_kw.args
+                      and dotted_name(env_kw.args[0]).endswith("environ"))
+                     or dn.endswith("environ.copy"))):
+            out.spawns.append(SpawnSite(path, node.lineno, target,
+                                        inherits_environ=True))
+            continue
+        if isinstance(env_kw, ast.Dict):
+            keys = tuple(sorted(
+                k.value for k in env_kw.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)))
+            out.spawns.append(SpawnSite(path, node.lineno, target,
+                                        inherits_environ=False,
+                                        explicit_keys=keys))
+            continue
+        if isinstance(env_kw, ast.Name):
+            fn = node
+            while fn in parents and not isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = parents[fn]
+            body = fn.body if isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef)) else tree.body
+            inherits, keys = _env_provenance(body, env_kw.id)
+            out.spawns.append(SpawnSite(path, node.lineno, target,
+                                        inherits_environ=inherits,
+                                        explicit_keys=keys))
+            continue
+        # unknown provenance: assume inheriting (no false positives)
+        out.spawns.append(SpawnSite(path, node.lineno, target,
+                                    inherits_environ=True))
+
+
+def extract_env(modules) -> EnvContracts:
+    out = EnvContracts()
+    # knob-name constants travel across modules (``from ...recovery
+    # import ENV_RESUME_POLL_S``): build a project-wide fallback table
+    # of unambiguous RAFIKI_*-valued string constants. Local constants
+    # always win; a name bound to two distinct values resolves nowhere.
+    global_consts: Dict[str, object] = {}
+    ambiguous: Set[str] = set()
+    for m in modules:
+        for name, value in _module_consts(m.tree).items():
+            if not (isinstance(value, str) and value.startswith(PREFIX)):
+                continue
+            if name in global_consts and global_consts[name] != value:
+                ambiguous.add(name)
+            global_consts[name] = value
+    for name in ambiguous:
+        del global_consts[name]
+    for m in sorted(modules, key=lambda m: m.path):
+        parents = parent_map(m.tree)
+        consts = dict(global_consts)
+        consts.update(_module_consts(m.tree))
+        helpers = _env_helpers(m.tree, consts)
+        for node in ast.walk(m.tree):
+            hr = _helper_read(node, helpers, consts)
+            if hr is not None:
+                hr.path = m.path
+                out.reads.append(hr)
+                continue
+            got = _env_read(node, consts)
+            if got is None:
+                continue
+            knob, default_node, required = got
+            default, dynamic = _default_repr(default_node, consts)
+            out.reads.append(KnobRead(
+                path=m.path, line=node.lineno, knob=knob,
+                default=default, dynamic_default=dynamic,
+                required=required,
+                parse=_parse_type(node, parents)))
+        _extract_spawns(m.path, m.tree, out)
+    out.reads.sort(key=lambda r: (r.knob, r.path, r.line))
+    out.spawns.sort(key=lambda s: (s.path, s.line))
+    return out
+
+
+def knobs_in_closure(project_modules: Dict[str, "object"],
+                     imports_of, target_module: str,
+                     env: EnvContracts) -> Dict[str, List[KnobRead]]:
+    """Knob reads reachable from ``target_module`` through the analyzed
+    import graph (the spawned child's static read set)."""
+    closure: Set[str] = set()
+    frontier = [target_module]
+    while frontier:
+        name = frontier.pop()
+        if name in closure or name not in project_modules:
+            continue
+        closure.add(name)
+        for imp in imports_of(project_modules[name].tree):
+            # an import of rafiki_tpu.x.y also pulls rafiki_tpu.x
+            parts = imp.split(".")
+            for i in range(1, len(parts) + 1):
+                frontier.append(".".join(parts[:i]))
+    paths = {m.path for name, m in project_modules.items()
+             if name in closure}
+    out: Dict[str, List[KnobRead]] = {}
+    for r in env.reads:
+        if r.path in paths:
+            out.setdefault(r.knob, []).append(r)
+    return out

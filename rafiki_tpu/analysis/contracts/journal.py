@@ -1,0 +1,588 @@
+"""Journal writer↔reader contract extraction.
+
+Writers are ``<...>journal.record(kind, name, field=...)`` call sites;
+the kind/name arguments resolve through module-level string constants
+(``audit.py``'s ``KIND = "advisor"``). Readers are statically
+recognizable *expectations* that some writer produces a kind (or a
+kind/name pair, or a field on it):
+
+* filter comparisons — ``r.get("kind") == "mesh"``,
+  ``kind != "perf": continue`` guards, ``name in ("hops", "ts")`` —
+  including the ``kind, name = r.get("kind"), r.get("name")`` alias
+  idiom and comprehension ``if`` clauses;
+* ``REQUIRED_KINDS``-style module constants of ``"kind/name"`` strings
+  (the twin calibrators' fail-loud lists);
+* helper predicates whose parameters flow into a kind/name comparison
+  (``_journal_has(recs, "mesh", "repack")``) — each constant-argument
+  call site is a reader expectation.
+
+Field expectations are ``r.get("f")``/``r["f"]`` accesses (and the
+``{f: r.get(f) for f in ("a", "b")}`` projection idiom) lexically under
+an active kind filter. Anything dynamic degrades gracefully: a
+non-constant kind at a writer site becomes a ``dynamic_writers`` entry
+(manifest-visible, checker-invisible), a constant-kind writer with a
+dynamic name becomes a wildcard writer for that kind, and ``**kwargs``
+at a writer site marks its field set open so RF015 stays silent on it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from rafiki_tpu.analysis.checkers._ast_util import dotted_name
+
+#: Fields every record carries regardless of the writer's kwargs
+#: (stamped by ``Journal.record`` itself).
+IMPLICIT_FIELDS = frozenset(
+    {"ts", "pid", "role", "kind", "name", "trace_id"})
+
+_REQUIRED_KINDS_NAME = re.compile(r"^[A-Z_]*KINDS?$")
+
+
+@dataclass
+class WriterSite:
+    path: str
+    line: int
+    kind: Optional[str]          # None: dynamic kind (manifest warning)
+    name: Optional[str]          # None: dynamic name (wildcard writer)
+    fields: Tuple[str, ...] = ()
+    dynamic_fields: bool = False  # **kwargs present: field set is open
+
+    @property
+    def key(self) -> Optional[str]:
+        if self.kind is None:
+            return None
+        return f"{self.kind}/{self.name if self.name is not None else '*'}"
+
+
+@dataclass
+class ReaderSite:
+    path: str
+    line: int
+    kind: str
+    name: Optional[str]          # None: kind-only filter
+    source: str = "filter"       # filter | required-kinds | helper-call
+    fields: List[str] = field(default_factory=list)
+
+    @property
+    def key(self) -> str:
+        return f"{self.kind}/{self.name if self.name is not None else '*'}"
+
+
+@dataclass
+class JournalContracts:
+    writers: List[WriterSite] = field(default_factory=list)
+    readers: List[ReaderSite] = field(default_factory=list)
+    dynamic_writers: List[WriterSite] = field(default_factory=list)
+
+    # -- joined views --------------------------------------------------------
+
+    def writer_pairs(self) -> Dict[str, List[WriterSite]]:
+        out: Dict[str, List[WriterSite]] = {}
+        for w in self.writers:
+            if w.key is not None:
+                out.setdefault(w.key, []).append(w)
+        return out
+
+    def writer_kinds(self) -> Set[str]:
+        return {w.kind for w in self.writers if w.kind is not None}
+
+    def wildcard_kinds(self) -> Set[str]:
+        """Kinds written with a dynamic name — any name matches."""
+        return {w.kind for w in self.writers
+                if w.kind is not None and w.name is None}
+
+    def reader_pairs(self) -> Dict[str, List[ReaderSite]]:
+        out: Dict[str, List[ReaderSite]] = {}
+        for r in self.readers:
+            out.setdefault(r.key, []).append(r)
+        return out
+
+    def kinds_read_wholesale(self) -> Set[str]:
+        """Kinds some reader consumes without a name filter."""
+        return {r.kind for r in self.readers if r.name is None}
+
+    def fields_written(self, kind: str, name: Optional[str]
+                       ) -> Optional[Set[str]]:
+        """Union of fields at every writer site matching kind (and
+        name, when given). None when any matching site has an open
+        field set — the sound degrade for **kwargs writers."""
+        sites = [w for w in self.writers if w.kind == kind
+                 and (name is None or w.name is None or w.name == name)]
+        if not sites or any(w.dynamic_fields or w.name is None
+                            for w in sites):
+            return None
+        out: Set[str] = set(IMPLICIT_FIELDS)
+        for w in sites:
+            out.update(w.fields)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Joins (the substance of RF014/RF015)
+# ---------------------------------------------------------------------------
+
+
+def unread_writer_keys(jc: "JournalContracts") -> List[str]:
+    """Writer kind/name keys no reader consumes — by exact pair, by a
+    kind-only wholesale filter, or (for dynamic-name writers) by any
+    reader of that kind."""
+    wholesale = jc.kinds_read_wholesale()
+    reader_keys = set(jc.reader_pairs())
+    reader_kinds = {r.kind for r in jc.readers}
+    out: List[str] = []
+    for key in sorted(jc.writer_pairs()):
+        kind, _, name = key.partition("/")
+        if kind in wholesale or key in reader_keys:
+            continue
+        if name == "*" and kind in reader_kinds:
+            continue
+        out.append(key)
+    return out
+
+
+def unknown_reader_keys(jc: "JournalContracts") -> List[str]:
+    """Reader expectations no writer satisfies — the loud side of a
+    renamed kind, whichever side was renamed."""
+    writer_keys = set(jc.writer_pairs())
+    kinds = jc.writer_kinds()
+    wildcards = jc.wildcard_kinds()
+    out: List[str] = []
+    for key in sorted(jc.reader_pairs()):
+        kind, _, name = key.partition("/")
+        if name == "*":
+            if kind in kinds:
+                continue
+        elif key in writer_keys or kind in wildcards:
+            continue
+        out.append(key)
+    return out
+
+
+def missing_reader_fields(jc: "JournalContracts"
+                          ) -> List[Tuple["ReaderSite", List[str]]]:
+    """(reader site, fields it expects that no matching writer emits),
+    only where every matching writer's field set is fully static."""
+    out: List[Tuple[ReaderSite, List[str]]] = []
+    for r in jc.readers:
+        if not r.fields:
+            continue
+        written = jc.fields_written(r.kind, r.name)
+        if written is None:
+            continue
+        missing = sorted(f for f in r.fields if f not in written)
+        if missing:
+            out.append((r, missing))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Extraction
+# ---------------------------------------------------------------------------
+
+
+def _module_str_consts(tree: ast.Module) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _const_str(node: Optional[ast.AST],
+               consts: Dict[str, str]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _const_str_seq(node: ast.AST,
+                   consts: Dict[str, str]) -> Optional[List[str]]:
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        vals = [_const_str(e, consts) for e in node.elts]
+        if vals and all(v is not None for v in vals):
+            return vals  # type: ignore[return-value]
+    return None
+
+
+def _is_journal_record(call: ast.Call) -> bool:
+    parts = dotted_name(call.func).split(".")
+    return (len(parts) >= 2 and parts[-1] == "record"
+            and "journal" in parts[-2])
+
+
+def _extract_writers(path: str, tree: ast.Module,
+                     consts: Dict[str, str], out: JournalContracts) -> None:
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_journal_record(node)):
+            continue
+        args: List[Optional[ast.AST]] = list(node.args[:2])
+        while len(args) < 2:
+            args.append(None)
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        kind_node = args[0] if args[0] is not None else kw.get("kind")
+        name_node = args[1] if args[1] is not None else kw.get("name")
+        kind = _const_str(kind_node, consts)
+        name = _const_str(name_node, consts)
+        fields = tuple(sorted(k.arg for k in node.keywords
+                              if k.arg and k.arg not in ("kind", "name")
+                              and k.arg not in IMPLICIT_FIELDS))
+        dynamic_fields = any(k.arg is None for k in node.keywords)
+        site = WriterSite(path=path, line=node.lineno, kind=kind,
+                          name=name, fields=fields,
+                          dynamic_fields=dynamic_fields)
+        if kind is None:
+            out.dynamic_writers.append(site)
+        else:
+            out.writers.append(site)
+
+
+def _extract_required_kinds(path: str, tree: ast.Module,
+                            out: JournalContracts) -> None:
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and _REQUIRED_KINDS_NAME.match(node.targets[0].id)):
+            continue
+        vals = _const_str_seq(node.value, {})
+        if not vals or not all("/" in v for v in vals):
+            continue
+        for v in vals:
+            kind, _, name = v.partition("/")
+            out.readers.append(ReaderSite(
+                path=path, line=node.lineno, kind=kind,
+                name=name if name != "*" else None,
+                source="required-kinds"))
+
+
+# -- reader filter analysis --------------------------------------------------
+
+
+@dataclass
+class _Constraint:
+    role: str                    # "kind" | "name"
+    basevar: Optional[str]
+    values: List[str]
+    positive: bool
+
+
+class _Scope:
+    """One function (or the module body) being scanned for reader
+    expectations. Aliases are collected flow-insensitively first —
+    ``kind, name = r.get("kind"), r.get("name")`` is the common idiom
+    and always precedes its comparisons in practice."""
+
+    def __init__(self, path: str, consts: Dict[str, str],
+                 params: Sequence[str], out: JournalContracts):
+        self.path = path
+        self.consts = consts
+        self.params = set(params)
+        self.out = out
+        self.aliases: Dict[str, Tuple[str, Optional[str]]] = {}
+        #: param name -> "kind" | "name" (helper predicate detection)
+        self.param_roles: Dict[str, str] = {}
+
+    # -- alias collection ----------------------------------------------------
+
+    def collect_aliases(self, body: Sequence[ast.stmt]) -> None:
+        for node in ast.walk(ast.Module(body=list(body), type_ignores=[])):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt, val = node.targets[0], node.value
+            pairs: List[Tuple[ast.AST, ast.AST]] = []
+            if (isinstance(tgt, ast.Tuple) and isinstance(val, ast.Tuple)
+                    and len(tgt.elts) == len(val.elts)):
+                pairs = list(zip(tgt.elts, val.elts))
+            else:
+                pairs = [(tgt, val)]
+            for t, v in pairs:
+                got = self._record_expr(v, allow_alias=False)
+                if got is not None and isinstance(t, ast.Name):
+                    self.aliases[t.id] = got
+
+    # -- expression classification -------------------------------------------
+
+    def _record_expr(self, node: ast.AST, allow_alias: bool = True
+                     ) -> Optional[Tuple[str, Optional[str]]]:
+        """``(role, basevar)`` when ``node`` reads the kind/name of a
+        journal record: ``r.get("kind")``, ``r["kind"]`` or an alias."""
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get" and node.args
+                and isinstance(node.func.value, ast.Name)):
+            key = _const_str(node.args[0], {})
+            if key in ("kind", "name"):
+                return key, node.func.value.id
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)):
+            key = _const_str(node.slice, {})
+            if key in ("kind", "name"):
+                return key, node.value.id
+        if allow_alias and isinstance(node, ast.Name):
+            return self.aliases.get(node.id)
+        return None
+
+    def _field_access(self, node: ast.AST,
+                      constloops: Dict[str, List[str]]
+                      ) -> Optional[Tuple[str, List[str]]]:
+        """``(basevar, fields)`` for ``r.get("f")``/``r["f"]``; the
+        projection idiom ``r.get(f)`` with ``f`` looping a constant
+        tuple yields every looped field."""
+        base: Optional[str] = None
+        keynode: Optional[ast.AST] = None
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get" and node.args
+                and isinstance(node.func.value, ast.Name)):
+            base, keynode = node.func.value.id, node.args[0]
+        elif (isinstance(node, ast.Subscript)
+              and isinstance(node.value, ast.Name)
+              and isinstance(node.ctx, ast.Load)):
+            base, keynode = node.value.id, node.slice
+        if base is None or keynode is None:
+            return None
+        k = _const_str(keynode, self.consts)
+        if k is not None:
+            return base, [k]
+        if isinstance(keynode, ast.Name) and keynode.id in constloops:
+            return base, list(constloops[keynode.id])
+        return None
+
+    def _comparisons(self, test: ast.AST) -> List[_Constraint]:
+        out: List[_Constraint] = []
+        for node in ast.walk(test):
+            if not (isinstance(node, ast.Compare) and len(node.ops) == 1):
+                continue
+            left, op, right = node.left, node.ops[0], node.comparators[0]
+            got = self._record_expr(left)
+            if got is None:  # reversed operand order
+                got = self._record_expr(right)
+                left, right = right, left
+            if got is None:
+                continue
+            role, basevar = got
+            if isinstance(op, (ast.Eq, ast.NotEq)):
+                v = _const_str(right, self.consts)
+                if v is not None:
+                    out.append(_Constraint(role, basevar, [v],
+                                           isinstance(op, ast.Eq)))
+                elif (isinstance(right, ast.Name)
+                      and right.id in self.params):
+                    self.param_roles.setdefault(right.id, role)
+            elif isinstance(op, (ast.In, ast.NotIn)):
+                vs = _const_str_seq(right, self.consts)
+                if vs:
+                    out.append(_Constraint(role, basevar, vs,
+                                           isinstance(op, ast.In)))
+        return out
+
+    # -- context-carrying walk -----------------------------------------------
+
+    def walk(self, body: Sequence[ast.stmt]) -> None:
+        self._walk_block(body, _Ctx())
+
+    def _refine(self, ctx: "_Ctx", cons: List[_Constraint],
+                line: int, source: str = "filter") -> "_Ctx":
+        kinds = sorted({v for c in cons if c.role == "kind"
+                        for v in c.values})
+        names = sorted({v for c in cons if c.role == "name"
+                        for v in c.values})
+        basevars = {c.basevar for c in cons if c.basevar}
+        if not kinds and not names:
+            return ctx
+        new = _Ctx(kinds=kinds or ctx.kinds,
+                   names=names or None,
+                   basevars=ctx.basevars | basevars,
+                   sites=list(ctx.sites))
+        if not new.kinds:
+            return new  # a name filter with no kind in scope: untracked
+        fresh: List[ReaderSite] = []
+        if names:
+            for k in new.kinds:
+                for n in names:
+                    fresh.append(ReaderSite(self.path, line, k, n,
+                                            source=source))
+        elif kinds:
+            for k in kinds:
+                fresh.append(ReaderSite(self.path, line, k, None,
+                                        source=source))
+        self.out.readers.extend(fresh)
+        new.sites = list(ctx.sites) + fresh
+        return new
+
+    def _walk_block(self, stmts: Sequence[ast.stmt], ctx: "_Ctx") -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # nested scopes are processed on their own
+            if isinstance(st, ast.If):
+                cons = self._comparisons(st.test)
+                self._scan_expr(st.test, ctx)
+                pos = [c for c in cons if c.positive]
+                body_ctx = self._refine(ctx, pos, st.test.lineno)
+                self._walk_block(st.body, body_ctx)
+                self._walk_block(st.orelse, ctx)
+                neg = [c for c in cons if not c.positive]
+                if neg and _terminates(st.body):
+                    flipped = [_Constraint(c.role, c.basevar, c.values,
+                                           True) for c in neg]
+                    ctx = self._refine(ctx, flipped, st.test.lineno)
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                self._scan_expr(st.iter, ctx)
+                self._walk_block(st.body, ctx)
+                self._walk_block(st.orelse, ctx)
+                continue
+            if isinstance(st, ast.While):
+                self._scan_expr(st.test, ctx)
+                self._walk_block(st.body, ctx)
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    self._scan_expr(item.context_expr, ctx)
+                self._walk_block(st.body, ctx)
+                continue
+            if isinstance(st, ast.Try):
+                self._walk_block(st.body, ctx)
+                for h in st.handlers:
+                    self._walk_block(h.body, ctx)
+                self._walk_block(st.orelse, ctx)
+                self._walk_block(st.finalbody, ctx)
+                continue
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._scan_expr(child, ctx)
+
+    def _scan_expr(self, node: ast.AST, ctx: "_Ctx",
+                   constloops: Optional[Dict[str, List[str]]] = None
+                   ) -> None:
+        constloops = constloops or {}
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            gen_ctx = ctx
+            loops = dict(constloops)
+            for comp in node.generators:
+                self._scan_expr(comp.iter, gen_ctx, loops)
+                vals = _const_str_seq(comp.iter, self.consts)
+                if vals is not None and isinstance(comp.target, ast.Name):
+                    loops[comp.target.id] = vals
+                for if_ in comp.ifs:
+                    cons = self._comparisons(if_)
+                    gen_ctx = self._refine(
+                        gen_ctx, [c for c in cons if c.positive],
+                        if_.lineno)
+                    self._scan_expr(if_, gen_ctx, loops)
+            if isinstance(node, ast.DictComp):
+                self._scan_expr(node.key, gen_ctx, loops)
+                self._scan_expr(node.value, gen_ctx, loops)
+            else:
+                # a bare comparison element (the ``any(... == ...)``
+                # predicate shape) is itself a reader expectation
+                cons = self._comparisons(node.elt)
+                elt_ctx = self._refine(
+                    gen_ctx, [c for c in cons if c.positive],
+                    node.elt.lineno)
+                self._scan_expr(node.elt, elt_ctx, loops)
+            return
+        got = self._field_access(node, constloops)
+        if got is not None and ctx.kinds and got[0] in ctx.basevars:
+            for f in got[1]:
+                if f in IMPLICIT_FIELDS:
+                    continue
+                for site in ctx.sites:
+                    if f not in site.fields:
+                        site.fields.append(f)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.comprehension,
+                                  ast.keyword)):
+                self._scan_expr(child, ctx, constloops)
+
+
+@dataclass
+class _Ctx:
+    kinds: List[str] = field(default_factory=list)
+    names: Optional[List[str]] = None
+    basevars: Set[str] = field(default_factory=set)
+    sites: List[ReaderSite] = field(default_factory=list)
+
+
+def _terminates(body: Sequence[ast.stmt]) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Continue, ast.Return, ast.Raise, ast.Break))
+
+
+def _functions(tree: ast.Module) -> Iterable[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def extract_journal(modules) -> JournalContracts:
+    """Whole-tree journal contracts from ModuleContext-likes (need
+    ``.path`` and ``.tree``)."""
+    out = JournalContracts()
+    #: (module path, helper fn name) -> {param index: role}
+    helpers: Dict[Tuple[str, str], Dict[int, str]] = {}
+    mods = sorted(modules, key=lambda m: m.path)
+    for m in mods:
+        consts = _module_str_consts(m.tree)
+        _extract_writers(m.path, m.tree, consts, out)
+        _extract_required_kinds(m.path, m.tree, out)
+        # module body + each function is its own reader scope
+        scope = _Scope(m.path, consts, (), out)
+        scope.collect_aliases(m.tree.body)
+        scope.walk(m.tree.body)
+        for fn in _functions(m.tree):
+            params = [a.arg for a in fn.args.args + fn.args.kwonlyargs]
+            fscope = _Scope(m.path, consts, params, out)
+            fscope.collect_aliases(fn.body)
+            fscope.walk(fn.body)
+            if fscope.param_roles:
+                idx = {name: i for i, name in enumerate(params)}
+                helpers[(m.path, fn.name)] = {
+                    idx[p]: role for p, role in fscope.param_roles.items()
+                    if p in idx}
+    _extract_helper_calls(mods, helpers, out)
+    out.writers.sort(key=lambda w: (w.path, w.line, w.key or ""))
+    out.dynamic_writers.sort(key=lambda w: (w.path, w.line))
+    out.readers.sort(key=lambda r: (r.path, r.line, r.key))
+    return out
+
+
+def _extract_helper_calls(mods, helpers, out: JournalContracts) -> None:
+    """Constant-argument calls to detected helper predicates — a
+    ``_journal_has(recs, "mesh", "repack")`` site expects mesh/repack.
+    Same-module resolution only (the live helpers are private)."""
+    by_name: Dict[Tuple[str, str], Dict[int, str]] = helpers
+    if not by_name:
+        return
+    for m in mods:
+        consts = _module_str_consts(m.tree)
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = dotted_name(node.func).rsplit(".", 1)[-1]
+            roles = by_name.get((m.path, leaf))
+            if not roles:
+                continue
+            kind = name = None
+            for i, role in roles.items():
+                if i < len(node.args):
+                    v = _const_str(node.args[i], consts)
+                    if role == "kind":
+                        kind = v
+                    elif role == "name":
+                        name = v
+            if kind is not None:
+                out.readers.append(ReaderSite(
+                    m.path, node.lineno, kind, name,
+                    source="helper-call"))
+    out.readers.sort(key=lambda r: (r.path, r.line, r.key))

@@ -1,0 +1,201 @@
+"""Deterministic contracts-manifest assembly.
+
+``manifest_for_paths`` parses the analyzed tree once, runs the three
+extractors, joins in the prom golden and docs/telemetry.md when the
+repo root carries them, and returns a plain-dict manifest.
+``dump_manifest`` serializes it byte-deterministically (sorted keys,
+two-space indent, trailing newline) — the committed golden at
+tests/data/contracts_manifest.json is diffed against this exact byte
+stream by scripts/check_lint.sh, so any contract drift (a renamed
+journal kind, a new env knob, a dropped metric) shows up as a
+reviewable diff, not a silent divergence.
+
+All paths in the manifest are repo-root-relative with ``/`` separators
+regardless of how the analyzed paths were spelled on the command line.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from rafiki_tpu.analysis.core import _collect_py_files, module_name_for
+from rafiki_tpu.analysis.contracts.envknobs import (
+    EnvContracts, extract_env)
+from rafiki_tpu.analysis.contracts.journal import (
+    JournalContracts, extract_journal, missing_reader_fields,
+    unknown_reader_keys, unread_writer_keys)
+from rafiki_tpu.analysis.contracts.telem import (
+    TelemetryContracts, documented_names, extract_telemetry,
+    is_documented, join_prom_golden)
+
+MANIFEST_VERSION = 1
+
+#: Repo-root-relative locations the telemetry join reads when present.
+PROM_GOLDEN = os.path.join("tests", "data", "prom_golden.txt")
+TELEMETRY_DOCS = os.path.join("docs", "telemetry.md")
+
+
+@dataclass
+class _Mod:
+    path: str
+    module_name: str
+    tree: ast.Module
+
+
+def _site(path: str, line: int) -> str:
+    return f"{path}:{line}"
+
+
+def _rel(path: str, root: Optional[str]) -> str:
+    if root:
+        path = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    return path.replace(os.sep, "/")
+
+
+def _load_modules(paths: Sequence[str], root: Optional[str]) -> List[_Mod]:
+    mods: List[_Mod] = []
+    for path in _collect_py_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError):
+            continue  # lint proper reports parse errors; manifest skips
+        mods.append(_Mod(path=_rel(path, root),
+                         module_name=module_name_for(path), tree=tree))
+    mods.sort(key=lambda m: m.path)
+    return mods
+
+
+# ---------------------------------------------------------------------------
+# Section builders
+# ---------------------------------------------------------------------------
+
+
+def _journal_section(jc: JournalContracts) -> dict:
+    writers: Dict[str, dict] = {}
+    for key, sites in sorted(jc.writer_pairs().items()):
+        fields = sorted({f for w in sites for f in w.fields})
+        writers[key] = {
+            "fields": fields,
+            "open_fields": any(w.dynamic_fields or w.name is None
+                               for w in sites),
+            "sites": sorted(_site(w.path, w.line) for w in sites),
+        }
+    readers: Dict[str, dict] = {}
+    for key, sites in sorted(jc.reader_pairs().items()):
+        readers[key] = {
+            "fields": sorted({f for r in sites for f in r.fields}),
+            "sources": sorted({r.source for r in sites}),
+            "sites": sorted(_site(r.path, r.line) for r in sites),
+        }
+    return {
+        "writers": writers,
+        "readers": readers,
+        "dynamic_writers": sorted(_site(w.path, w.line)
+                                  for w in jc.dynamic_writers),
+        "unread": unread_writer_keys(jc),
+        "unknown": unknown_reader_keys(jc),
+        "missing_fields": sorted(
+            ({"site": _site(r.path, r.line), "key": r.key, "fields": miss}
+             for r, miss in missing_reader_fields(jc)),
+            key=lambda d: (d["site"], d["key"])),
+    }
+
+
+def _env_section(env: EnvContracts) -> dict:
+    divergent = set(env.divergent())
+    knobs: Dict[str, dict] = {}
+    for knob, reads in sorted(env.by_knob().items()):
+        knobs[knob] = {
+            "parse": sorted({r.parse for r in reads}),
+            "defaults": sorted({str(r.manifest_default()) for r in reads}),
+            "sites": sorted(_site(r.path, r.line) for r in reads),
+            "divergent": knob in divergent,
+        }
+    spawns = [{
+        "site": _site(s.path, s.line),
+        "target": s.target_module,
+        "inherits_environ": s.inherits_environ,
+        "explicit_keys": sorted(k for k in s.explicit_keys
+                                if k.startswith("RAFIKI_")),
+    } for s in env.spawns]
+    return {"knobs": knobs, "spawns": spawns}
+
+
+def _telemetry_section(tc: TelemetryContracts,
+                       docs_text: Optional[str],
+                       golden_text: Optional[str]) -> dict:
+    metrics: Dict[str, dict] = {}
+    exact, wild = documented_names(docs_text) if docs_text else (set(), set())
+    for name, sites in sorted(tc.names().items()):
+        entry = {
+            "api": sorted({s.api for s in sites}),
+            "sites": sorted(_site(s.path, s.line) for s in sites),
+        }
+        if docs_text is not None:
+            entry["documented"] = is_documented(name, exact, wild)
+        metrics[name] = entry
+    out = {
+        "metrics": metrics,
+        "dynamic_sites": [{"site": _site(d.path, d.line),
+                           "prefix": d.prefix, "api": d.api}
+                          for d in tc.dynamic_sites],
+        "collectors": {c.name: sorted(_site(s.path, s.line)
+                                      for s in tc.collectors
+                                      if s.name == c.name)
+                       for c in tc.collectors},
+    }
+    if golden_text is not None:
+        out["prom_golden"] = join_prom_golden(golden_text, tc)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def build_manifest(modules, docs_text: Optional[str] = None,
+                   golden_text: Optional[str] = None) -> dict:
+    """Manifest dict from already-parsed module-likes (``.path``,
+    ``.tree``). Pure — no filesystem access — so tests can feed
+    synthetic trees."""
+    jc = extract_journal(modules)
+    env = extract_env(modules)
+    tc = extract_telemetry(modules)
+    return {
+        "version": MANIFEST_VERSION,
+        "journal": _journal_section(jc),
+        "env": _env_section(env),
+        "telemetry": _telemetry_section(tc, docs_text, golden_text),
+    }
+
+
+def manifest_for_paths(paths: Sequence[str],
+                       root: Optional[str] = None) -> dict:
+    """Parse ``paths`` and build the manifest, joining the prom golden
+    and telemetry docs found under ``root`` (default: cwd)."""
+    root = root or os.getcwd()
+    mods = _load_modules(paths, root)
+
+    def _read(rel: str) -> Optional[str]:
+        p = os.path.join(root, rel)
+        try:
+            with open(p, "r", encoding="utf-8") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+    return build_manifest(mods, docs_text=_read(TELEMETRY_DOCS),
+                          golden_text=_read(PROM_GOLDEN))
+
+
+def dump_manifest(manifest: dict) -> str:
+    """The byte-deterministic serialization the golden is diffed
+    against."""
+    return json.dumps(manifest, indent=2, sort_keys=True) + "\n"

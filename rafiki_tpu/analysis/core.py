@@ -101,7 +101,16 @@ class ProjectContext:
 
     def __init__(self, modules: Dict[str, ModuleContext]):
         self.modules = modules            # module_name -> ctx
+        self._facts: Dict[str, object] = {}
         self.jax_tainted: Set[str] = self._compute_jax_taint()
+
+    def fact(self, key: str, compute):
+        """Memoized whole-program fact shared across checkers — the
+        contracts extraction (RF014–RF016) walks every tree once per
+        run through this, not once per (checker, module) pair."""
+        if key not in self._facts:
+            self._facts[key] = compute(self)
+        return self._facts[key]
 
     # -- jax import taint ----------------------------------------------------
 

@@ -570,6 +570,67 @@ def cmd_serving(log_dir: str, n: int, as_json: bool) -> int:
     return 0
 
 
+def cmd_decisions(log_dir: str, n: int, as_json: bool) -> int:
+    """Control-plane decision forensics: route switches, admission
+    sheds, breaker flips, and twin placement advisories merged into
+    one time-ordered stream — the "why did serving degrade at 14:03"
+    view. Each of these kinds is write-once forensic state; this is
+    their reader (RF014)."""
+    rows = []
+    for r in journal_mod.read_dir(log_dir):
+        kind, name = r.get("kind"), r.get("name")
+        if kind == "serving" and name == "route":
+            rows.append(("route", r))
+        elif kind == "gateway" and name == "shed":
+            rows.append(("shed", r))
+        elif kind == "gateway" and name == "breaker_transition":
+            rows.append(("breaker", r))
+        elif kind == "twin" and name == "placement":
+            rows.append(("placement", r))
+    if not rows:
+        print(f"no decision records under {log_dir} (routes, sheds, "
+              f"breaker transitions, placement advisories)",
+              file=sys.stderr)
+        return 1
+    rows.sort(key=lambda kr: kr[1].get("ts", 0.0))
+    shown = rows[-n:] if n else rows
+    if as_json:
+        for _, r in shown:
+            print(json.dumps(r, default=str))
+        return 0
+    for tag, r in shown:
+        ts = r.get("ts")
+        if tag == "route":
+            line = (f"route={r.get('route')} job={r.get('job_id')} "
+                    f"k={r.get('k')} reason={r.get('reason')} "
+                    f"workers={r.get('workers')}")
+        elif tag == "shed":
+            line = f"reason={r.get('reason')}"
+        elif tag == "breaker":
+            line = (f"worker={r.get('worker_id')} "
+                    f"{r.get('from_state')}→{r.get('to_state')}")
+        else:
+            line = (f"job={r.get('job_id')} k={r.get('k')} "
+                    f"chips={r.get('chips')} "
+                    f"rec={r.get('recommendation')} "
+                    f"advisory={r.get('advisory')}")
+        print(f"{ts:>14.3f}  {tag:<9} {line}" if isinstance(ts, float)
+              else f"{str(ts):>14}  {tag:<9} {line}")
+    sheds: Dict[str, int] = {}
+    flips: Dict[str, int] = {}
+    for tag, r in rows:
+        if tag == "shed":
+            k = str(r.get("reason"))
+            sheds[k] = sheds.get(k, 0) + 1
+        elif tag == "breaker":
+            k = str(r.get("worker_id"))
+            flips[k] = flips.get(k, 0) + 1
+    print(f"{len(rows)} decisions"
+          + (f"; sheds by reason: {sheds}" if sheds else "")
+          + (f"; breaker transitions by worker: {flips}" if flips else ""))
+    return 0
+
+
 def cmd_autoscale(log_dir: str, n: int, as_json: bool, check: bool,
                   window_s: float, max_flips: int) -> int:
     """Replay the controller's decision stream; with ``--check``, gate
@@ -672,6 +733,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp = sub.add_parser("serving",
                         help="continuous serving time-series rows")
     sp.add_argument("-n", type=int, default=32)
+    sp = sub.add_parser("decisions",
+                        help="control-plane decision stream: routes, "
+                             "sheds, breaker flips, placement advisories")
+    sp.add_argument("-n", type=int, default=32,
+                    help="show the last N decisions (0 = all)")
     sp = sub.add_parser("autoscale",
                         help="elasticity controller decision replay")
     sp.add_argument("-n", type=int, default=32,
@@ -715,6 +781,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_tails(log_dir, args.json, args.check, args.tolerance)
     if args.cmd == "serving":
         return cmd_serving(log_dir, args.n, args.json)
+    if args.cmd == "decisions":
+        return cmd_decisions(log_dir, args.n, args.json)
     if args.cmd == "autoscale":
         return cmd_autoscale(log_dir, args.n, args.json, args.check,
                              args.window, args.flips)

@@ -78,6 +78,7 @@ class Ledger:
                 row = self._entities.setdefault(name, {})
                 row["wall_s"] = row.get("wall_s", 0.0) + dt
                 split = dict(row)
+            # lint: disable=RF014 — per-entity cost audit stream read offline (notebooks/goodput post-mortems), not by code
             _journal.record("ledger", name, **{
                 k: round(v, 6) for k, v in split.items()})
 

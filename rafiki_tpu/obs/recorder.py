@@ -83,6 +83,7 @@ def dump(reason: str, log_dir: Optional[str | os.PathLike] = None,
         with open(tmp, "w") as f:
             json.dump(payload, f, default=str)
         os.replace(tmp, path)
+        # lint: disable=RF014 — flight records are breadcrumbs to the dump files; consumed by humans/grep, not code
         journal.record("flight", reason, path=str(path))
         return path
     except Exception:

@@ -222,7 +222,11 @@ def main() -> int:
 
     t0 = time.monotonic()
     problems = []
-    pack = max(2, int(os.environ.get("RAFIKI_TRIAL_PACK", "4")))
+    # Export the smoke's wider default instead of reading with a
+    # different fallback than the library (RF016): every reader in
+    # this process (and any child) now agrees on the width.
+    os.environ.setdefault("RAFIKI_TRIAL_PACK", "4")
+    pack = max(2, int(os.environ["RAFIKI_TRIAL_PACK"]))
     with tempfile.TemporaryDirectory(prefix="rafiki-perfsmoke-") as tmp:
         bench = check_bench_gate(problems, tmp)
 
@@ -248,6 +252,7 @@ def main() -> int:
         packed_rows = []
         try:
             packed_rows = [r for r in _profile_via_cli(quiet_dir)
+                           # lint: disable=RF014 — obs profile CLI rows keyed by program kind, not journal records
                            if r.get("kind") == "packed"]
         except (RuntimeError, ValueError, KeyError) as e:
             problems.append(f"obs profile failed on quiet dir: {e}")

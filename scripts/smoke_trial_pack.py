@@ -61,7 +61,11 @@ def main() -> int:
     from rafiki_tpu.store import MetaStore, ParamsStore
     from rafiki_tpu.worker.train import InProcAdvisorHandle, TrainWorker
 
-    pack = max(2, int(os.environ.get("RAFIKI_TRIAL_PACK", "4")))
+    # Export the smoke's wider default instead of reading with a
+    # different fallback than the library (RF016): every reader in
+    # this process (and any child) now agrees on the width.
+    os.environ.setdefault("RAFIKI_TRIAL_PACK", "4")
+    pack = max(2, int(os.environ["RAFIKI_TRIAL_PACK"]))
     t0 = time.monotonic()
     with tempfile.TemporaryDirectory(prefix="rafiki-packsmoke-") as tmp:
         store = MetaStore(os.path.join(tmp, "meta.sqlite3"))

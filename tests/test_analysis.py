@@ -30,7 +30,7 @@ def _analyze_snippet(tmp_path, source, name="snippet.py", select=None):
 def test_all_builtin_checkers_registered():
     assert {"RF001", "RF002", "RF003", "RF004", "RF005", "RF006",
             "RF007", "RF008", "RF009", "RF010", "RF011",
-            "RF012", "RF013"} <= set(REGISTRY)
+            "RF012", "RF013", "RF014", "RF015", "RF016"} <= set(REGISTRY)
 
 
 # ---------------------------------------------------------------------------
@@ -1120,3 +1120,100 @@ def test_rf013_current_scheduler_is_clean():
     r = analyze_paths([os.path.join(REPO, "rafiki_tpu")], select=["RF013"])
     mine = [f for f in r.unsuppressed if f.checker_id == "RF013"]
     assert mine == [], [f"{f.path}:{f.line}" for f in mine]
+
+
+# ---------------------------------------------------------------------------
+# RF014/RF016 — regression fixtures for the live violations this
+# analysis surfaced when first enabled (fixed in bench.py,
+# scripts/smoke_trial_pack.py, scripts/perf_smoke.py, and closed by the
+# `obs decisions` reader). Each fixture freezes the *fixed* shape as
+# quiet and the pre-fix shape as firing, so the fixes can't regress.
+# ---------------------------------------------------------------------------
+
+
+def _tree(tmp_path, files):
+    import textwrap as _tw
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    paths = []
+    for name, src in files.items():
+        f = tmp_path / name
+        f.write_text(_tw.dedent(src))
+        paths.append(str(f))
+    return paths
+
+
+def test_rf016_bench_trials_regression(tmp_path):
+    # pre-fix bench.py: two reads of RAFIKI_BENCH_TRIALS with mode-
+    # specific defaults "3"/"30" → divergent
+    r = analyze_paths(_tree(tmp_path, {"bench_old.py": """
+        import os
+        def scale(mode):
+            if mode == "cpu":
+                return int(os.environ.get("RAFIKI_BENCH_TRIALS", "3"))
+            return int(os.environ.get("RAFIKI_BENCH_TRIALS", "30"))
+        """}), select=["RF016"])
+    assert any("RAFIKI_BENCH_TRIALS" in f.message for f in r.unsuppressed)
+    # the fix: one env read, mode-specific fallback in code
+    r = analyze_paths(_tree(tmp_path / "fixed", {"bench_new.py": """
+        import os
+        def scale(mode):
+            env_trials = os.environ.get("RAFIKI_BENCH_TRIALS")
+            if mode == "cpu":
+                return int(env_trials) if env_trials else 3
+            return int(env_trials) if env_trials else 30
+        """}), select=["RF016"])
+    assert r.unsuppressed == []
+
+
+def test_rf016_trial_pack_setdefault_regression(tmp_path):
+    # pre-fix smoke scripts defaulted RAFIKI_TRIAL_PACK to "4" while
+    # the worker defaults to "1" → divergent
+    worker = """
+        import os
+        PACK = int(os.environ.get("RAFIKI_TRIAL_PACK", "1"))
+        """
+    r = analyze_paths(_tree(tmp_path, {"worker.py": worker,
+                                       "smoke_old.py": """
+        import os
+        pack = max(2, int(os.environ.get("RAFIKI_TRIAL_PACK", "4")))
+        """}), select=["RF016"])
+    assert any("RAFIKI_TRIAL_PACK" in f.message for f in r.unsuppressed)
+    # the fix: setdefault (a write, not a defaulted read) + required read
+    r = analyze_paths(_tree(tmp_path / "fixed", {"worker.py": worker,
+                                                 "smoke_new.py": """
+        import os
+        os.environ.setdefault("RAFIKI_TRIAL_PACK", "4")
+        pack = max(2, int(os.environ["RAFIKI_TRIAL_PACK"]))
+        """}), select=["RF016"])
+    assert r.unsuppressed == []
+
+
+def test_rf014_decisions_reader_closes_control_plane_records(tmp_path):
+    # the four control-plane records were write-only until the
+    # `obs decisions` CLI reader; its elif-chain shape must keep
+    # counting as a reader for every branch
+    writers = """
+        def emit(journal):
+            journal.record("serving", "route", reason="warm")
+            journal.record("gateway", "shed", reason="capacity")
+            journal.record("gateway", "breaker_transition", state="open")
+            journal.record("twin", "placement", plan="p0")
+        """
+    r = analyze_paths(_tree(tmp_path, {"writers.py": writers}),
+                      select=["RF014"])
+    assert len(r.unsuppressed) == 4  # write-only: all four flagged
+    r = analyze_paths(_tree(tmp_path / "fixed", {"writers.py": writers,
+                                                 "decisions.py": """
+        def decisions(recs):
+            for r in recs:
+                kind, name = r.get("kind"), r.get("name")
+                if kind == "serving" and name == "route":
+                    yield "route", r.get("reason")
+                elif kind == "gateway" and name == "shed":
+                    yield "shed", r.get("reason")
+                elif kind == "gateway" and name == "breaker_transition":
+                    yield "breaker", r.get("state")
+                elif kind == "twin" and name == "placement":
+                    yield "twin", r.get("plan")
+        """}), select=["RF014"])
+    assert r.unsuppressed == []
