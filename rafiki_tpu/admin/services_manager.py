@@ -81,6 +81,11 @@ class ServicesManager:
         self._train_jobs: Dict[str, _TrainJobHandle] = {}
         self._inference_jobs: Dict[str, _InferenceJobHandle] = {}
         self._lock = threading.Lock()
+        # Fleet-level tenant arbitration (docs/multitenancy.md): when a
+        # JobAdmissionGate is attached, create_inference_services runs
+        # every NEW job's forecast through the serving twin and refuses
+        # jobs whose load would breach an existing tenant's SLO.
+        self.job_gate = None
         # Crash-recovery reaper state (docs/recovery.md).
         self._reaper_thread: Optional[threading.Thread] = None
         self._reaper_stop: Optional[threading.Event] = None
@@ -251,12 +256,24 @@ class ServicesManager:
 
     # -- inference services --------------------------------------------------
 
+    def attach_job_gate(self, gate) -> None:
+        """Attach a :class:`~rafiki_tpu.tenancy.arbiter.
+        JobAdmissionGate`: from now on every new inference job that
+        declares a tenant is forecast through the twin first, and a
+        job whose load would breach an existing tenant's p99 budget
+        raises ``JobRejected`` instead of starting services."""
+        self.job_gate = gate
+
     def create_inference_services(self, inference_job_id: str,
                                   best_trials: List[dict],
                                   batch_size: Optional[int] = None,
                                   serve_http: bool = True,
                                   gateway_overrides: Optional[Dict[str, Any]]
-                                  = None) -> Predictor:
+                                  = None,
+                                  tenancy=None,
+                                  tenant: Optional[str] = None,
+                                  tier: Optional[str] = None,
+                                  expected_qps: float = 0.0) -> Predictor:
         """One inference worker per trial + a predictor over the bus
         fronted by a serving Gateway (admission control, quorum
         fan-out, breakers — docs/serving.md), plus (by default) a
@@ -265,15 +282,29 @@ class ServicesManager:
 
         ``gateway_overrides`` lets a job pick its own routing policy
         and limits (e.g. ``{"policy": "least-loaded",
-        "max_inflight": 4}``) over the framework-config defaults."""
+        "max_inflight": 4}``) over the framework-config defaults.
+
+        Tenancy (docs/multitenancy.md): pass a ``TenantFabric`` as
+        ``tenancy`` for a tenant-aware gateway (weighted-fair
+        admission + per-tenant accounting). ``tenant``/``tier``/
+        ``expected_qps`` declare whose load this job is — with a job
+        gate attached, the declared load is twin-forecast against the
+        fleet and the job can be REJECTED before any service starts."""
         if not best_trials:
             raise ValueError("No completed trials to serve")
+        if self.job_gate is not None and tenant is not None:
+            from rafiki_tpu.tenancy.qos import DEFAULT_TIER
+
+            # Raises JobRejected (journaling tenancy/arbiter) when the
+            # forecast breaches an existing tenant's budget.
+            self.job_gate.admit_job(inference_job_id, tenant,
+                                    tier or DEFAULT_TIER, expected_qps)
         handle = _InferenceJobHandle()
         batch_size = batch_size or self.config.inference_batch_size
         try:
             return self._start_inference(handle, inference_job_id, best_trials,
                                          batch_size, serve_http,
-                                         gateway_overrides or {})
+                                         gateway_overrides or {}, tenancy)
         except Exception:
             # Tear down whatever already started — otherwise worker
             # threads (each pinning a trained model) leak unreachably.
@@ -289,7 +320,8 @@ class ServicesManager:
     def _start_inference(self, handle: "_InferenceJobHandle",
                          inference_job_id: str, best_trials: List[dict],
                          batch_size: int, serve_http: bool,
-                         gateway_overrides: Dict[str, Any]) -> Predictor:
+                         gateway_overrides: Dict[str, Any],
+                         tenancy=None) -> Predictor:
         models = [self._load_trial_model(t) for t in best_trials]
 
         # Same-architecture top-k → ONE worker running a stacked vmapped
@@ -343,7 +375,8 @@ class ServicesManager:
                                      timeout_s=self.config.predict_timeout_s)
         handle.gateway = Gateway(handle.predictor,
                                  GatewayConfig.from_config(
-                                     self.config, **gateway_overrides))
+                                     self.config, **gateway_overrides),
+                                 tenancy=tenancy)
         for th in handle.worker_threads:
             th.start()
         # Wait for workers to register so the first query doesn't race them.
@@ -379,6 +412,124 @@ class ServicesManager:
         with self._lock:
             self._inference_jobs[inference_job_id] = handle
         return handle.predictor
+
+    # -- co-hosted serving (docs/multitenancy.md) ----------------------------
+
+    def _make_program_loader(self, trials: List[dict], batch_size: int):
+        """A lazy model loader for one co-hosted job: runs on residency
+        MISS (first query, or re-activation after an LRU eviction),
+        never at service creation — a cold job costs zero HBM until it
+        is actually queried."""
+        def load():
+            models = [self._load_trial_model(t) for t in trials]
+            if len(models) == 1:
+                return models[0]
+            from rafiki_tpu.parallel.serving import build_stacked
+
+            stacked, _ = build_stacked(trials, models,
+                                       batch_size=batch_size)
+            return stacked if stacked is not None else models[0]
+
+        return load
+
+    def create_cohosted_inference_services(
+            self, job_trials: Dict[str, List[dict]],
+            batch_size: Optional[int] = None,
+            gateway_overrides: Optional[Dict[str, Any]] = None,
+            tenancy_for: Optional[Dict[str, Any]] = None,
+            hbm_budget_bytes: Optional[int] = None) -> Dict[str, Predictor]:
+        """ONE inference worker serving EVERY job in ``job_trials``
+        behind a :class:`~rafiki_tpu.tenancy.hosting.ProgramHost`:
+        models swap in and out of a shared HBM byte budget by LRU
+        residency (journaled ``tenancy/residency``) instead of each
+        job pinning a dedicated worker — the k-models-many-jobs
+        generalization of the stacked route. Each job keeps its OWN
+        Predictor + Gateway (admission, QoS and metrics stay per-job);
+        the predictor tags queries with the job's program id and the
+        host routes them. ``tenancy_for`` maps job id → TenantFabric
+        for jobs that want tenant-aware gateways.
+
+        Returns ``{job_id: Predictor}``. The shared worker is owned by
+        the FIRST job's handle; the cohort shares one stop event, so
+        stopping ANY co-hosted job stops serving for all of them —
+        co-hosting trades blast-radius isolation for HBM efficiency
+        and that trade is explicit here."""
+        if not job_trials:
+            raise ValueError("No jobs to co-host")
+        from rafiki_tpu.tenancy.hosting import ProgramHost, ProgramSpec
+        from rafiki_tpu.tenancy.residency import ResidencyManager
+
+        batch_size = batch_size or self.config.inference_batch_size
+        job_ids = list(job_trials)
+        specs = []
+        for job_id, trials in job_trials.items():
+            if not trials:
+                raise ValueError(f"Job {job_id} has no completed trials")
+            # HBM charge estimate: the params blobs' on-disk bytes
+            # (floored — an estimate of 0 would make eviction free).
+            size = sum(self.params_store.size(t["params_id"])
+                       for t in trials if t.get("params_id"))
+            specs.append(ProgramSpec(
+                program_id=job_id,
+                loader=self._make_program_loader(list(trials), batch_size),
+                size_bytes=max(size, 1 << 20)))
+        host = ProgramHost(specs,
+                           residency=ResidencyManager(hbm_budget_bytes))
+        primary, extras = job_ids[0], job_ids[1:]
+        worker_id = f"cohost-{primary[:8]}-iw0"
+        stop_event = threading.Event()
+        worker = InferenceWorker(self.bus, primary, worker_id, host,
+                                 batch_size=batch_size,
+                                 stop_event=stop_event,
+                                 extra_job_ids=extras)
+        service = self.store.create_service(
+            ServiceType.INFERENCE_WORKER.value, job_id=primary,
+            worker_index=0)
+        th = threading.Thread(target=self._run_inference_worker,
+                              args=(worker, service["id"]),
+                              name=worker_id, daemon=True)
+        th.start()
+        _journal.record("tenancy", "cohost", worker_id=worker_id,
+                        jobs=list(job_ids),
+                        budget_bytes=host.residency.budget_bytes)
+        # Wait for the worker to register under every co-hosted job id
+        # so the first query doesn't race registration.
+        import time
+        t0 = time.monotonic()
+        while (any(worker_id not in self.bus.get_workers(j)
+                   for j in job_ids)
+               # lint: disable=RF007 — bounded startup wait, not traced
+               and time.monotonic() - t0 < 5.0):
+            time.sleep(0.01)
+        predictors: Dict[str, Predictor] = {}
+        fabrics = tenancy_for or {}
+        for job_id in job_ids:
+            handle = _InferenceJobHandle()
+            handle.stop_event = stop_event  # cohort-shared by design
+            if job_id == primary:
+                handle.workers.append(worker)
+                handle.worker_threads.append(th)
+            handle.best_trials = list(job_trials[job_id])
+            handle.batch_size = batch_size
+            self.store.create_service(ServiceType.PREDICTOR.value,
+                                      job_id=job_id)
+            handle.predictor = Predictor(
+                self.bus, job_id, timeout_s=self.config.predict_timeout_s,
+                program=job_id)
+            handle.gateway = Gateway(handle.predictor,
+                                     GatewayConfig.from_config(
+                                         self.config,
+                                         **(gateway_overrides or {})),
+                                     tenancy=fabrics.get(job_id))
+            self.store.update_inference_job(
+                job_id, status=InferenceJobStatus.RUNNING.value,
+                predictor_host=None)
+            events.emit("inference_job_started", job_id=job_id,
+                        n_workers=1, predictor_host=None)
+            with self._lock:
+                self._inference_jobs[job_id] = handle
+            predictors[job_id] = handle.predictor
+        return predictors
 
     def _run_inference_worker(self, worker: InferenceWorker, service_id: str) -> None:
         self.store.update_service(service_id, status=ServiceStatus.RUNNING.value)
@@ -477,6 +628,27 @@ class ServicesManager:
         if max_workers is not None:
             overrides["max_size"] = max_workers
         spec = _asc.LaneSpec.from_env("inference", **overrides)
+        if (handle.gateway is not None
+                and getattr(handle.gateway, "tenancy", None) is not None):
+            # Tenant-aware fleet (docs/multitenancy.md): the lane
+            # scales on the WORST of the classic inference pressure
+            # and the tenant aggregates (worst per-tenant burn /
+            # tenant shed rate) — one tenant burning its p99 budget is
+            # a capacity signal even while the global queue is calm.
+            import dataclasses as _dc
+
+            from rafiki_tpu.tenancy.arbiter import tenant_pressure
+
+            base_fn = spec.pressure_fn
+
+            def _tenant_aware(sensors, _base=base_fn):
+                bp, breason = _base(sensors)
+                tp, treason = tenant_pressure(sensors)
+                if bp is None or (tp is not None and tp > bp):
+                    return tp, treason
+                return bp, breason
+
+            spec = _dc.replace(spec, pressure_fn=_tenant_aware)
         controller = _asc.AutoscaleController(
             lanes=[spec],
             sensor_fn=lambda: _asc.read_sensors(gateway=handle.gateway),

@@ -1,0 +1,155 @@
+"""RF017 unbounded-per-tenant-state.
+
+Multi-tenant serving keeps per-tenant ledgers everywhere — admission
+slots, accounting stats, residency charges. Tenant ids arrive off the
+wire (an HTTP header the gateway forwards verbatim), so any long-lived
+mapping keyed by tenant id grows one entry per id EVER probed: a
+client rotating ids is an unbounded memory leak in the serving plane.
+This is RF003's defaultdict-read-leak generalized to the write side —
+inserting per-key state on the request path leaks exactly the same
+way whether the insert came from a read or a write.
+
+Rule: in a tenancy-touching module (under ``rafiki_tpu/tenancy/`` or
+importing ``rafiki_tpu.tenancy``), a class attribute initialized as a
+bare ``{}``/``dict()``/``defaultdict()``/``OrderedDict()`` and written
+with a tenant-derived key (``self.X[tenant] = ...`` or
+``self.X.setdefault(tenant, ...)``) must show eviction somewhere in
+the same class: a ``pop``/``popitem``/``clear`` on the attribute, a
+``del self.X[...]``, or a ``len(self.X)`` cap check. The sanctioned
+idiom is :class:`rafiki_tpu.tenancy.accounting.BoundedTenantMap`
+(LRU cap + an eviction counter), which never matches because it is
+not a bare dict.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from rafiki_tpu.analysis.checkers._ast_util import is_self_attr
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+
+_DICT_CTORS = {"dict", "defaultdict", "OrderedDict"}
+_EVICTORS = {"pop", "popitem", "clear"}
+
+
+def _tenancy_scoped(ctx: ModuleContext) -> bool:
+    if ctx.module_name.startswith("rafiki_tpu.tenancy"):
+        return True
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.startswith("rafiki_tpu.tenancy")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.startswith("rafiki_tpu.tenancy"):
+                return True
+            if mod == "rafiki_tpu" and any(a.name == "tenancy"
+                                           for a in node.names):
+                return True
+    return False
+
+
+def _dict_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes assigned a bare dict-like container anywhere in the
+    class (a BoundedTenantMap assignment deliberately never matches)."""
+    attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        is_dict = isinstance(value, ast.Dict)
+        if isinstance(value, ast.Call):
+            fn = value.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            is_dict = name in _DICT_CTORS
+        if not is_dict:
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            attr = is_self_attr(t)
+            if attr:
+                attrs.add(attr)
+    return attrs
+
+
+def _mentions_tenant(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and "tenant" in n.id.lower():
+            return True
+        if isinstance(n, ast.Attribute) and "tenant" in n.attr.lower():
+            return True
+    return False
+
+
+def _bounded_attrs(cls: ast.ClassDef, attrs: Set[str]) -> Set[str]:
+    """Attributes the class demonstrably evicts from or caps."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _EVICTORS):
+            a = is_self_attr(node.func.value, attrs)
+            if a:
+                out.add(a)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    a = is_self_attr(t.value, attrs)
+                    if a:
+                        out.add(a)
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "len" and node.args):
+            a = is_self_attr(node.args[0], attrs)
+            if a:
+                out.add(a)
+    return out
+
+
+@register
+class UnboundedPerTenantState(Checker):
+    id = "RF017"
+    name = "unbounded-per-tenant-state"
+    severity = "warning"
+    rationale = ("tenant ids arrive off the wire: a dict keyed by them "
+                 "without eviction grows one entry per id ever probed — "
+                 "an unbounded leak under rotating ids (RF003's leak, "
+                 "write side)")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not _tenancy_scoped(ctx):
+            return []
+        findings: List[Finding] = []
+        for cls in [n for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            attrs = _dict_attrs(cls)
+            if not attrs:
+                continue
+            bounded = _bounded_attrs(cls, attrs)
+            for node in ast.walk(cls):
+                if (isinstance(node, ast.Subscript)
+                        and isinstance(node.ctx, ast.Store)):
+                    attr = is_self_attr(node.value, attrs)
+                    if (attr and attr not in bounded
+                            and _mentions_tenant(node.slice)):
+                        findings.append(self._leak(ctx, node, attr))
+                elif (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "setdefault"):
+                    attr = is_self_attr(node.func.value, attrs)
+                    if (attr and attr not in bounded and node.args
+                            and _mentions_tenant(node.args[0])):
+                        findings.append(self._leak(ctx, node, attr))
+        return findings
+
+    def _leak(self, ctx: ModuleContext, node: ast.AST, attr: str) -> Finding:
+        return self.finding(
+            ctx, node,
+            f"tenant-keyed write into `self.{attr}` with no eviction "
+            f"anywhere in the class — wire-supplied tenant ids make "
+            f"this an unbounded leak; cap it (pop/len check) or use "
+            f"tenancy.accounting.BoundedTenantMap")

@@ -105,7 +105,24 @@ def _knob_name(node: Optional[ast.AST],
         v = node.value
     elif isinstance(node, ast.Name):
         v = consts.get(node.id)
+    elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        # ``ENV_PREFIX + "TIERS"`` — fold one level of constant
+        # concatenation, mirroring what the helper resolver already
+        # does for prefixed wrapper functions.
+        left = _knob_name_part(node.left, consts)
+        right = _knob_name_part(node.right, consts)
+        if left is not None and right is not None:
+            v = left + right
     return v if isinstance(v, str) and v.startswith(PREFIX) else None
+
+
+def _knob_name_part(node: ast.AST,
+                    consts: Dict[str, object]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name) and isinstance(consts.get(node.id), str):
+        return str(consts[node.id])
+    return None
 
 
 def _env_read(node: ast.AST, consts: Dict[str, object]

@@ -139,6 +139,24 @@ KNOB_DOCS = {
         "instead of a dedicated pool; on by default",
     "RAFIKI_SUPERVISOR_HEARTBEAT_S": "supervisor liveness heartbeat "
         "cadence in the MetaStore",
+    "RAFIKI_TENANT_BATCH_WEIGHT": "batch-tier admission weight "
+        "(docs/multitenancy.md)",
+    "RAFIKI_TENANT_DEFAULT_TIER": "QoS tier for tenants absent from "
+        "RAFIKI_TENANT_TIERS (docs/multitenancy.md)",
+    "RAFIKI_TENANT_GOLD_WEIGHT": "gold-tier admission weight "
+        "(docs/multitenancy.md)",
+    "RAFIKI_TENANT_HBM_BUDGET_MB": "co-host HBM residency budget per "
+        "worker; 0 disables the cap (docs/multitenancy.md)",
+    "RAFIKI_TENANT_MAX_TENANTS": "bound on tracked per-tenant "
+        "admission/accounting state before LRU eviction",
+    "RAFIKI_TENANT_QUOTA_FRAC": "per-tenant cap as a fraction of "
+        "gateway inflight/queue capacity",
+    "RAFIKI_TENANT_STD_WEIGHT": "std-tier admission weight "
+        "(docs/multitenancy.md)",
+    "RAFIKI_TENANT_TIERS": "tenant→tier map, e.g. "
+        "\"alice=gold,bob=batch\" (docs/multitenancy.md)",
+    "RAFIKI_TENANT_UNWEIGHTED": "polarity knob: disable weighted "
+        "admission and quotas (tenancy smoke's doctored run)",
     "RAFIKI_TPU_DATA_DIR": "root for all durable state (stores, "
         "journals, caches)",
     "RAFIKI_TRACE_ID": "trace id stamped on every journal record of "

@@ -64,6 +64,12 @@ def _current_trace() -> Optional[Dict[str, Any]]:
     parent = telemetry.current_span_id()
     if parent:
         trace["parent_span"] = parent
+    # The tenant tag rides the same envelope field as the trace (and
+    # the PR 6 back-compat rule: absent key = untagged, old consumers
+    # ignore it) so worker-side records can attribute work per tenant.
+    tenant = _trace_context.current_tenant()
+    if tenant:
+        trace["tenant"] = tenant
     return trace
 
 

@@ -45,6 +45,7 @@ from rafiki_tpu.obs.anatomy import hops as _hops
 from rafiki_tpu.obs.anatomy.timeseries import ServingRollup
 from rafiki_tpu.obs.journal import journal as _journal
 from rafiki_tpu.predictor.predictor import default_quorum
+from rafiki_tpu.tenancy.qos import ANON_TENANT
 
 POLICIES = ("replicate-all", "least-loaded")
 
@@ -133,11 +134,21 @@ class GatewayConfig:
 class Gateway:
     """Serving frontend for one inference job's predictor."""
 
-    def __init__(self, predictor, config: Optional[GatewayConfig] = None):
+    def __init__(self, predictor, config: Optional[GatewayConfig] = None,
+                 tenancy=None):
         self.predictor = predictor
         self.cfg = config or GatewayConfig()
-        self.admission = AdmissionController(self.cfg.max_inflight,
-                                             self.cfg.max_queue)
+        # Multi-tenant opt-in (docs/multitenancy.md): a TenantFabric
+        # swaps the plain admission controller for the weighted-fair
+        # tenant-aware subclass, built against the same capacity knobs.
+        # No fabric → byte-identical single-tenant behaviour.
+        self.tenancy = tenancy
+        if tenancy is not None:
+            self.admission = tenancy.build_admission(self.cfg.max_inflight,
+                                                     self.cfg.max_queue)
+        else:
+            self.admission = AdmissionController(self.cfg.max_inflight,
+                                                 self.cfg.max_queue)
         self._lock = threading.Lock()
         self._breakers: Dict[str, CircuitBreaker] = {}
         self._draining = False
@@ -182,13 +193,18 @@ class Gateway:
                         breaker_cooldown_s=self.cfg.breaker_cooldown_s,
                         max_batch=self.cfg.max_batch,
                         max_batch_wait_ms=self.cfg.max_batch_wait_ms,
-                        blackout_retries=self.cfg.blackout_retries)
+                        blackout_retries=self.cfg.blackout_retries,
+                        tenants_enabled=self.tenancy is not None,
+                        tenant_quota_frac=(
+                            self.tenancy.directory.quota_frac
+                            if self.tenancy is not None else None))
 
     # -- the predict path ----------------------------------------------------
 
     def predict(self, queries: List[Any],
                 deadline_s: Optional[float] = None,
-                trace_id: Optional[str] = None) -> List[Any]:
+                trace_id: Optional[str] = None,
+                tenant: Optional[str] = None) -> List[Any]:
         """Admit → route → quorum-gather → feed breakers. Raises
         :class:`ShedError` when admission refuses, RuntimeError when
         the job has no live workers.
@@ -196,12 +212,18 @@ class Gateway:
         This is the trace edge: a request either carries a caller
         trace id (``X-Rafiki-Trace-Id`` upstream) or gets a fresh one
         here, and everything downstream — bus envelopes, worker spans,
-        journal records in every process — stitches to it."""
+        journal records in every process — stitches to it. The tenant
+        edge too (``X-Rafiki-Tenant``): with a :class:`TenantFabric`
+        attached, the tenant id rides the same thread-local into bus
+        envelopes, and admission/shed/latency are charged per tenant
+        (docs/multitenancy.md)."""
         with trace_context.trace(trace_id):
-            return self._predict(queries, deadline_s)
+            with trace_context.tenant_scope(tenant):
+                return self._predict(queries, deadline_s, tenant)
 
     def _predict(self, queries: List[Any],
-                 deadline_s: Optional[float]) -> List[Any]:
+                 deadline_s: Optional[float],
+                 tenant: Optional[str] = None) -> List[Any]:
         # Open this request's hop-mark prefix (docs/serving_anatomy.md):
         # admit/queue marks stamped here ride into every bus envelope
         # the fan-out produces. Cleared in the finally — a stale prefix
@@ -209,12 +231,18 @@ class Gateway:
         _hops.begin()
         _hops.add("admit")
         try:
-            return self._predict_admitted(queries, deadline_s)
+            return self._predict_admitted(queries, deadline_s, tenant)
         finally:
             _hops.clear()
 
     def _predict_admitted(self, queries: List[Any],
-                          deadline_s: Optional[float]) -> List[Any]:
+                          deadline_s: Optional[float],
+                          tenant: Optional[str] = None) -> List[Any]:
+        fabric = self.tenancy
+        if deadline_s is None and fabric is not None:
+            # Tenant-aware deadline default: the tier's deadline (gold
+            # shorter than batch) before the gateway-wide fallback.
+            deadline_s = fabric.directory.tier_of(tenant).deadline_s
         deadline_s = (deadline_s or self.cfg.default_deadline_s
                       or self.predictor.timeout_s)
         deadline = time.monotonic() + deadline_s
@@ -222,6 +250,8 @@ class Gateway:
             draining = self._draining
         if draining:
             self._count_shed("draining")
+            if fabric is not None:
+                fabric.accounting.shed(tenant or ANON_TENANT, "draining")
             raise ShedError("draining", self._retry_after())
         # Deadline-aware admission: don't hold a waiter past the point
         # where the expected service time no longer fits its deadline —
@@ -229,15 +259,26 @@ class Gateway:
         reserve = min(self._expected_service_s(),
                       deadline_s * DEADLINE_RESERVE_FRAC)
         try:
-            waited = self.admission.admit(deadline - reserve,
-                                          retry_after_s=self._retry_after())
+            if fabric is not None:
+                waited = self.admission.admit(
+                    deadline - reserve, retry_after_s=self._retry_after(),
+                    tenant=tenant)
+            else:
+                waited = self.admission.admit(
+                    deadline - reserve, retry_after_s=self._retry_after())
         except ShedError as e:
             self._count_shed(e.reason)
+            if fabric is not None:
+                # Charged to THIS tenant: the per-tenant shed ledger is
+                # how noisy-neighbor-shed proves who paid for a spike.
+                fabric.accounting.shed(tenant or ANON_TENANT, e.reason)
             raise
         _hops.add("queue")  # admission granted: the queue wait is over
         with self._lock:
             self._admitted += 1
         telemetry.inc("gateway.admitted")
+        if fabric is not None:
+            fabric.accounting.admitted(tenant or ANON_TENANT, waited)
         if waited:
             telemetry.observe("gateway.queue_wait_s", waited)
         # Chaos: an injected delay here is a frontend latency spike that
@@ -247,7 +288,7 @@ class Gateway:
         # drain-under-load scenarios need to stretch.
         chaos.hook("gateway.predict", self.predictor.job_id)
         if self._batcher is not None:
-            return self._predict_batched(queries, deadline)
+            return self._predict_batched(queries, deadline, tenant, waited)
         t0 = time.monotonic()
         try:
             # The gateway span is the trace root on the serving path:
@@ -258,7 +299,10 @@ class Gateway:
                                 queries=len(queries)):
                 report = self._fanout(queries, deadline)
         finally:
-            self.admission.release()
+            if fabric is not None:
+                self.admission.release(tenant)
+            else:
+                self.admission.release()
         # lint: disable=RF007 — breaker EWMA input; region is under the span
         elapsed = time.monotonic() - t0
         self._absorb(report, elapsed)
@@ -269,22 +313,32 @@ class Gateway:
         ok = report.timeouts == 0
         self.rollup.observe(latency_s=elapsed,
                             outcome="ok" if ok else "error")
+        if fabric is not None:
+            # The tenant ledger charges CALLER-observed latency: admission
+            # wait + service. Queue wait under contention is the whole
+            # noisy-neighbor signal — charging service time alone would
+            # let an interference victim's p99 read as healthy.
+            fabric.accounting.completed(tenant or ANON_TENANT,
+                                        waited + elapsed, ok)
         # Independent end-to-end record for hop-sum reconciliation:
         # obs waterfall / obs tails cross-check the stitched chain's
         # total against this gateway-measured elapsed for the trace.
         _journal.record("serving", "request", queries=len(queries),
                         e2e_s=round(elapsed, 6), ok=ok,
-                        hedged=report.hedged, timeouts=report.timeouts)
+                        hedged=report.hedged, timeouts=report.timeouts,
+                        tenant=tenant)
         from rafiki_tpu.obs.perf import slo as _slo
 
         _slo.maybe_tick()
         return report.outputs
 
-    def _predict_batched(self, queries: List[Any],
-                         deadline: float) -> List[Any]:
+    def _predict_batched(self, queries: List[Any], deadline: float,
+                         tenant: Optional[str] = None,
+                         waited: float = 0.0) -> List[Any]:
         """Microbatched path: ride a shared fan-out, keep per-request
         observability. The admission slot is held for the whole wait —
         the inflight budget still bounds concurrency."""
+        fabric = self.tenancy
         member = self._batcher.submit(queries, deadline,
                                       prefix=_hops.prefix_marks())
         try:
@@ -294,7 +348,10 @@ class Gateway:
             if not member.wait(max(0.0, deadline - time.monotonic()) + 2.0):
                 raise RuntimeError("microbatch flush timed out")
         finally:
-            self.admission.release()
+            if fabric is not None:
+                self.admission.release(tenant)
+            else:
+                self.admission.release()
         if member.error is not None:
             raise member.error
         report = member.report
@@ -304,6 +361,10 @@ class Gateway:
         ok = report.timeouts == 0
         self.rollup.observe(latency_s=elapsed,
                             outcome="ok" if ok else "error")
+        if fabric is not None:
+            # Caller-observed latency, same rule as the direct path.
+            fabric.accounting.completed(tenant or ANON_TENANT,
+                                        waited + elapsed, ok)
         # Re-absorb the shared flush chain under THIS request's trace
         # (prefix + bat + shared worker chain + dec): every member gets
         # a stitchable waterfall even though the wire saw one envelope.
@@ -312,7 +373,8 @@ class Gateway:
         _journal.record("serving", "request", queries=len(queries),
                         e2e_s=round(elapsed, 6), ok=ok,
                         hedged=report.hedged, timeouts=report.timeouts,
-                        batched=True, flush_reason=member.flush_reason)
+                        batched=True, flush_reason=member.flush_reason,
+                        tenant=tenant)
         from rafiki_tpu.obs.perf import slo as _slo
 
         _slo.maybe_tick()
@@ -543,7 +605,12 @@ class Gateway:
             # they already hold slots, so wait_idle covers them.
             self._batcher.drain()
         self.admission.close()
-        return self.admission.wait_idle(timeout)
+        done = self.admission.wait_idle(timeout)
+        if self.tenancy is not None:
+            # Durable counter summary (tenant/summary): the record
+            # `obs tenants --check` reconciles per-record tallies with.
+            self.tenancy.accounting.flush()
+        return done
 
     # -- introspection -------------------------------------------------------
 
@@ -597,7 +664,7 @@ class Gateway:
                 if b.snapshot().get("state") != "closed")
         waiting = self.admission.waiting
         total = admitted + shed
-        return {
+        out = {
             "queue_depth": waiting,
             "queue_frac": waiting / max(1, self.cfg.max_queue),
             "inflight": self.admission.inflight,
@@ -606,3 +673,8 @@ class Gateway:
             "breakers_open": breakers_open,
             "draining": draining,
         }
+        if self.tenancy is not None:
+            # Tenant aggregates (worst burn, tenant shed rate) ride the
+            # same snapshot: the arbiter lane's pressure inputs.
+            out.update(self.tenancy.sensors())
+        return out

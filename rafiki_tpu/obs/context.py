@@ -77,3 +77,27 @@ def configure_from_env() -> None:
     tid = os.environ.get(ENV_VAR)
     if tid:
         set_process_trace(tid)
+
+
+# -- tenant context -----------------------------------------------------------
+# The tenant id rides exactly like the trace id: bound at the serving
+# edge (gateway / HTTP header), carried per-thread, stamped into bus
+# envelopes by queues._current_trace so worker-side journal records can
+# attribute work to a tenant (docs/multitenancy.md). Unlike traces,
+# there is no fresh-id fallback — untagged work stays tenant-less.
+
+def current_tenant() -> Optional[str]:
+    """The active tenant id, or None for untagged work."""
+    return getattr(_tls, "tenant_id", None)
+
+
+@contextlib.contextmanager
+def tenant_scope(tenant: Optional[str]) -> Iterator[Optional[str]]:
+    """Bind ``tenant`` to this thread for the duration of the block
+    (None binds nothing but still restores the outer value)."""
+    prev = getattr(_tls, "tenant_id", None)
+    _tls.tenant_id = tenant if tenant is not None else prev
+    try:
+        yield tenant
+    finally:
+        _tls.tenant_id = prev

@@ -44,6 +44,7 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import json
+import math
 import random
 from hashlib import sha1
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -93,6 +94,15 @@ class TwinConfig:
     #: ONE fan-out serves the whole batch. 1 = per-request fan-out.
     max_batch: int = 1
     max_batch_wait_s: float = 0.005
+    #: Per-tenant QoS classes (docs/multitenancy.md): tenant id →
+    #: ``{"weight": w}``. None → tenant-blind admission (the
+    #: pre-tenancy gateway), byte-identical to earlier results. With
+    #: tenants set, admission mirrors TenantAdmissionController:
+    #: per-tenant queue/inflight quotas at ``tenant_quota_frac`` of
+    #: capacity and weighted-fair granting by inflight/weight charge.
+    tenants: Optional[Dict[str, Dict[str, float]]] = None
+    #: Mirror of TenantDirectory.quota_frac.
+    tenant_quota_frac: float = 0.5
 
     @classmethod
     def from_gateway(cls, g: GatewayConfig, workers: int,
@@ -145,12 +155,14 @@ class _Request:
     __slots__ = ("rid", "arrival", "queries", "deadline", "admit_deadline",
                  "admit_t", "join_t", "fanset", "quorum", "replies",
                  "decided", "done_q", "timeouts", "outcome", "done_t",
-                 "replied_by")
+                 "replied_by", "tenant")
 
-    def __init__(self, rid: int, arrival: float, queries: int):
+    def __init__(self, rid: int, arrival: float, queries: int,
+                 tenant: Optional[str] = None):
         self.rid = rid
         self.arrival = arrival
         self.queries = queries
+        self.tenant = tenant
         self.admit_t: Optional[float] = None
         self.join_t: Optional[float] = None   # microbatch former entry
         self.fanset: List[str] = []
@@ -210,12 +222,25 @@ class _Sim:
         self._hash = sha1()
         self._inflight_area = 0.0
         self._inflight_mark = 0.0
-        # Arrivals normalized to (t, n_queries).
-        self.arrivals: List[Tuple[float, int]] = [
-            (a, cfg.queries_per_request) if isinstance(a, (int, float))
-            else (float(a[0]), int(a[1]))
+        # Arrivals normalized to (t, n_queries, tenant) — plain floats
+        # and 2-tuples stay tenant-less (back-compat wire shapes).
+        self.arrivals: List[Tuple[float, int, Optional[str]]] = [
+            (float(a), cfg.queries_per_request, None)
+            if isinstance(a, (int, float))
+            else (float(a[0]), int(a[1]),
+                  a[2] if len(a) > 2 else None)
             for a in arrivals]
         self.arrivals.sort(key=lambda p: p[0])
+        # Per-tenant admission state (mirrors tenancy/admission.py);
+        # inert when cfg.tenants is None.
+        self.tenant_inflight: Dict[Optional[str], int] = {}
+        self.tenant_shed: Dict[Tuple[Optional[str], str], int] = {}
+        if cfg.tenants:
+            frac = min(1.0, max(0.05, cfg.tenant_quota_frac))
+            self.quota_inflight = max(1, int(math.ceil(
+                cfg.max_inflight * frac)))
+            self.quota_queue = (max(1, int(math.ceil(cfg.max_queue * frac)))
+                                if cfg.max_queue else 0)
 
     # -- plumbing ------------------------------------------------------------
 
@@ -267,12 +292,38 @@ class _Sim:
 
     # -- admission (mirrors AdmissionController.admit) -----------------------
 
+    def _weight(self, tenant: Optional[str]) -> float:
+        spec = (self.cfg.tenants or {}).get(tenant or "", {})
+        return max(float(spec.get("weight", 1.0)), 1e-9)
+
     def _arrive(self, req: _Request) -> None:
         self._log("arrive", f"r{req.rid}")
         reserve = min(self.ewma or 0.0,
                       self.cfg.deadline_s * DEADLINE_RESERVE_FRAC)
         req.deadline = req.arrival + self.cfg.deadline_s
         req.admit_deadline = req.deadline - reserve
+        if self.cfg.tenants:
+            # Tenant-aware admission (mirrors TenantAdmissionController
+            # shed order: tenant_quota before queue_full, so a flooder
+            # is charged before it can fill the shared queue).
+            t = req.tenant
+            if (self.inflight < self.cfg.max_inflight and not self.waiting
+                    and self.tenant_inflight.get(t, 0)
+                    < self.quota_inflight):
+                self._admit(req)
+            elif (self.quota_queue
+                    and sum(1 for r in self.waiting if r.tenant == t)
+                    >= self.quota_queue):
+                self._shed(req, "tenant_quota")
+            elif len(self.waiting) >= self.cfg.max_queue:
+                self._shed(req, "queue_full")
+            elif self.now >= req.admit_deadline:
+                self._shed(req, "deadline")
+            else:
+                self.waiting.append(req)
+                self.queue_peak = max(self.queue_peak, len(self.waiting))
+                self._push(req.admit_deadline, "queue_deadline", req)
+            return
         if self.inflight < self.cfg.max_inflight and not self.waiting:
             self._admit(req)
         elif len(self.waiting) >= self.cfg.max_queue:
@@ -284,9 +335,33 @@ class _Sim:
             self.queue_peak = max(self.queue_peak, len(self.waiting))
             self._push(req.admit_deadline, "queue_deadline", req)
 
+    def _next_waiter(self) -> Optional[_Request]:
+        """Weighted-fair grant: the head (FIFO-within-tenant) waiter of
+        the eligible tenant with the lowest inflight/weight charge,
+        arrival order breaking ties — the same selection rule as
+        TenantAdmissionController._chosen_tenant."""
+        heads: Dict[Optional[str], _Request] = {}
+        for r in self.waiting:
+            if r.tenant not in heads:
+                heads[r.tenant] = r
+        eligible = [r for r in heads.values()
+                    if self.tenant_inflight.get(r.tenant, 0)
+                    < self.quota_inflight]
+        if not eligible:
+            return None
+        return min(eligible,
+                   key=lambda r: (self.tenant_inflight.get(r.tenant, 0)
+                                  / self._weight(r.tenant), r.rid))
+
     def _pump(self) -> None:
         while self.inflight < self.cfg.max_inflight and self.waiting:
-            req = self.waiting.pop(0)
+            if self.cfg.tenants:
+                req = self._next_waiter()
+                if req is None:
+                    return   # everyone waiting is at their quota
+                self.waiting.remove(req)
+            else:
+                req = self.waiting.pop(0)
             if self.now >= req.admit_deadline:
                 self._shed(req, "deadline")
                 continue
@@ -297,10 +372,16 @@ class _Sim:
             return
         req.outcome = "shed:" + reason
         self.shed[reason] = self.shed.get(reason, 0) + 1
+        if self.cfg.tenants:
+            key = (req.tenant, reason)
+            self.tenant_shed[key] = self.tenant_shed.get(key, 0) + 1
         self._log("shed", f"r{req.rid} {reason}")
 
     def _admit(self, req: _Request) -> None:
         self._track_inflight(+1)
+        if self.cfg.tenants:
+            self.tenant_inflight[req.tenant] = (
+                self.tenant_inflight.get(req.tenant, 0) + 1)
         req.admit_t = self.now
         self._log("admit", f"r{req.rid}")
         fault = self._decide("gateway.predict", f"r{req.rid}")
@@ -310,7 +391,7 @@ class _Sim:
             req.outcome = "error"
             req.done_t = self.now
             self._log("done", f"r{req.rid} error")
-            self._release()
+            self._release(req)
             return
         delay = fault.delay_s if (fault is not None
                                   and fault.mode == "delay") else 0.0
@@ -319,8 +400,11 @@ class _Sim:
         else:
             self._route(req, self.now + delay + self._sample("route"))
 
-    def _release(self) -> None:
+    def _release(self, req: Optional[_Request] = None) -> None:
         self._track_inflight(-1)
+        if self.cfg.tenants and req is not None:
+            self.tenant_inflight[req.tenant] = max(
+                0, self.tenant_inflight.get(req.tenant, 0) - 1)
         self._pump()
 
     # -- gateway microbatch former (mirrors gateway/microbatch.py) -----------
@@ -520,13 +604,13 @@ class _Sim:
             a = LATENCY_EWMA_ALPHA
             self.ewma = (latency if self.ewma is None
                          else (1 - a) * self.ewma + a * latency)
-        self._release()
+        self._release(req)
 
     # -- main loop -----------------------------------------------------------
 
     def run(self) -> None:
-        for t, n in self.arrivals:
-            req = _Request(len(self.requests), t, n)
+        for t, n, tenant in self.arrivals:
+            req = _Request(len(self.requests), t, n, tenant=tenant)
             self.requests.append(req)
             self._push(t, "arrive", req)
         while self._heap:
@@ -627,6 +711,37 @@ def simulate(cal: Calibration, cfg: TwinConfig,
         "event_log_sha1": sim._hash.hexdigest(),
         "config": dataclasses.asdict(cfg),
     }
+    if cfg.tenants is not None:
+        tenant_ids = sorted({r.tenant for r in reqs} | set(cfg.tenants),
+                            key=lambda t: (t is None, t or ""))
+        blocks: Dict[str, Any] = {}
+        for tenant in tenant_ids:
+            rs = [r for r in reqs if r.tenant == tenant]
+            lat_t = sorted(r.done_t - r.admit_t for r in rs
+                           if r.outcome == "ok")
+            # Caller-observed latency (arrival→done, admission wait
+            # included) — the QoS p99 budget is a promise about THIS
+            # number, same rule as the gateway's tenant ledger: under
+            # contention the queue wait IS the noisy-neighbor signal.
+            full_t = sorted(r.done_t - r.arrival for r in rs
+                            if r.outcome == "ok")
+            shed_t = sum(v for (tt, _), v in sim.tenant_shed.items()
+                         if tt == tenant)
+            blocks[tenant or ""] = {
+                "requests": len(rs),
+                "ok": sum(1 for r in rs if r.outcome == "ok"),
+                "shed": shed_t,
+                "shed_reasons": dict(sorted(
+                    (reason, v)
+                    for (tt, reason), v in sim.tenant_shed.items()
+                    if tt == tenant)),
+                "p50_ms": _ms(_pct(lat_t, 50)),
+                "p99_ms": _ms(_pct(lat_t, 99)),
+                "full_p50_ms": _ms(_pct(full_t, 50)),
+                "full_p99_ms": _ms(_pct(full_t, 99)),
+                "shed_rate": round(shed_t / len(rs), 4) if rs else None,
+            }
+        result["tenants"] = blocks
     if cfg.max_batch > 1:
         result["microbatch"] = {
             "flushes": dict(sorted(sim.batch_flushes.items())),

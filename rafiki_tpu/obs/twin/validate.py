@@ -118,3 +118,107 @@ def _rel_err(pred: Optional[float], meas: Optional[float]
     if pred is None or meas is None or meas <= 0:
         return None
     return abs(pred - meas) / meas
+
+
+# -- per-tenant validation (docs/multitenancy.md) --------------------------
+
+#: Per-tenant percentile gates need fewer points than the global gate:
+#: a --tenants capture splits the same run across tenants, and the
+#: skewed (aggressor) side would otherwise dominate the floor.
+MIN_TENANT_REQUESTS = 10
+
+
+def tenant_measured_from_records(records: List[Dict[str, Any]]):
+    """(arrivals, per-tenant latencies, tenant→tier) from a
+    ``--tenants`` capture. Arrivals are (offset_s, queries, tenant)
+    3-tuples — the tenant-aware wire shape engine.simulate accepts;
+    tiers come from the ``tenant/admit`` accounting records."""
+    rows = [r for r in records
+            if r.get("kind") == "serving" and r.get("name") == "request"
+            and isinstance(r.get("e2e_s"), (int, float))
+            and isinstance(r.get("ts"), (int, float))]
+    if not rows:
+        return [], {}, {}
+    starts = [(float(r["ts"]) - float(r["e2e_s"]),
+               int(r.get("queries") or 1), r.get("tenant")) for r in rows]
+    t0 = min(s for s, _, _ in starts)
+    arrivals = sorted((s - t0, q, t) for s, q, t in starts)
+    lats: Dict[Optional[str], List[float]] = {}
+    for r in rows:
+        lats.setdefault(r.get("tenant"), []).append(float(r["e2e_s"]))
+    for xs in lats.values():
+        xs.sort()
+    tiers: Dict[str, str] = {}
+    for r in records:
+        if (r.get("kind") == "tenant" and r.get("name") == "admit"
+                and r.get("tenant") and r.get("tier")):
+            tiers[str(r["tenant"])] = str(r["tier"])
+    return arrivals, lats, tiers
+
+
+def validate_tenants(log_dir, seed: int = 0,
+                     tolerance: float = DEFAULT_TOLERANCE,
+                     scales: Optional[Dict[str, float]] = None
+                     ) -> Dict[str, Any]:
+    """Score the twin's weighted-admission model against a captured
+    ``bench_serving --tenants`` run: replay the per-tenant arrival
+    trains through the simulator with the capture's own tier weights
+    and gate each tenant's predicted p99 against its measured p99.
+    This is the model-fidelity check behind the new-job pre-gate
+    (tenancy.arbiter.JobAdmissionGate): a gate that forecasts with an
+    unvalidated model is just a random number generator with a journal.
+    """
+    from rafiki_tpu.tenancy.qos import DEFAULT_TIER, TIERS
+
+    records = journal_mod.read_dir(log_dir)
+    cal = Calibration.from_journal_dir(log_dir)
+    if scales:
+        cal = cal.scaled(scales)
+    arrivals, lats, tier_names = tenant_measured_from_records(records)
+    total = sum(len(xs) for xs in lats.values())
+    if total < MIN_REQUESTS:
+        raise ValueError(
+            f"only {total} serving/request record(s) in {log_dir}; need "
+            f">= {MIN_REQUESTS} (run bench_serving --smoke --tenants "
+            f"with RAFIKI_LOG_DIR set)")
+    tiers = TIERS()
+    classes = {t: {"weight": tiers.get(tier_names.get(t, ""),
+                                       tiers[DEFAULT_TIER]).weight}
+               for t in lats if t is not None}
+    cfg = TwinConfig.from_calibration(cal, tenants=classes)
+    res = simulate(cal, cfg, arrivals, seed=seed)
+    per_tenant: Dict[str, Any] = {}
+    gated = 0
+    ok = True
+    for tenant, xs in sorted((t, x) for t, x in lats.items()
+                             if t is not None):
+        meas_p99 = round(_pct_ms(xs, 99), 3)
+        pred = (res.get("tenants", {}).get(tenant, {}) or {})
+        err = _rel_err(pred.get("p99_ms"), meas_p99)
+        scored = len(xs) >= MIN_TENANT_REQUESTS
+        if scored:
+            gated += 1
+            ok = ok and err is not None and err <= tolerance
+        per_tenant[tenant] = {
+            "tier": tier_names.get(tenant, DEFAULT_TIER),
+            "measured_requests": len(xs),
+            "measured_p99_ms": meas_p99,
+            "predicted_p99_ms": pred.get("p99_ms"),
+            "predicted_shed": pred.get("shed"),
+            "p99_err": None if err is None else round(err, 4),
+            "gated": scored,
+        }
+    ok = ok and gated > 0
+    return {
+        "twin_schema_version": VALIDATE_SCHEMA_VERSION,
+        "source": str(log_dir),
+        "seed": seed,
+        "tolerance": tolerance,
+        "scales": dict(scales or {}),
+        "tenants": per_tenant,
+        "gated_tenants": gated,
+        "ok": ok,
+        "event_log_sha1": res["event_log_sha1"],
+        "config": res["config"],
+        "created_ts": round(time.time(), 3),  # lint: disable=RF010 — artifact timestamp, not simulation state; determinism covers everything above
+    }

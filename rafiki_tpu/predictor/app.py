@@ -114,10 +114,17 @@ class PredictorApp:
         # the trace id; otherwise the gateway mints one. Either way the
         # id is echoed back so callers can `obs trace <id>` the request.
         trace_id = request.headers.get("X-Rafiki-Trace-Id")
+        # Tenant propagation in (docs/multitenancy.md): the caller's
+        # tenant id (or the body's "tenant" key) charges admission,
+        # shed and latency accounting to that tenant when the gateway
+        # has a TenantFabric. Absent header = anonymous bucket.
+        tenant = (request.headers.get("X-Rafiki-Tenant")
+                  or body.get("tenant"))
         from rafiki_tpu.obs import context as trace_context
 
         with trace_context.trace(trace_id) as tid:
-            preds = self.gateway.predict(queries, deadline_s=deadline_s)
+            preds = self.gateway.predict(queries, deadline_s=deadline_s,
+                                         tenant=tenant)
         response = self._json({"predictions": _jsonable(preds),
                                "trace_id": tid})
         response.headers["X-Rafiki-Trace-Id"] = tid

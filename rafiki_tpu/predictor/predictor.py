@@ -92,10 +92,18 @@ class Predictor:
     def __init__(self, bus, job_id: str, timeout_s: float = 10.0,
                  worker_ttl_s: float = 3.0,
                  min_replies: Optional[int] = None,
-                 hedge_grace_s: float = DEFAULT_HEDGE_GRACE_S):
+                 hedge_grace_s: float = DEFAULT_HEDGE_GRACE_S,
+                 program: Optional[str] = None):
         self.bus = bus
         self.job_id = job_id
         self.timeout_s = timeout_s
+        # Co-hosted serving (docs/multitenancy.md): when this job's
+        # model lives in a shared multi-model worker (ProgramHost),
+        # every fanned-out query is tagged with the job's program id —
+        # the same payload-envelope trick as BATCH_KEY — so the host
+        # routes it to the right resident model. None = classic
+        # one-job-per-worker wire format, untouched.
+        self.program = program
         # Liveness lease TTL: workers heartbeat every ~0.5s from a
         # dedicated thread (worker/inference.py), so a worker missing
         # for worker_ttl_s is dead (SIGKILL never runs remove_worker).
@@ -107,6 +115,15 @@ class Predictor:
         # passes an explicit quorum (ceil(k/2) unless configured).
         self.min_replies = min_replies
         self.hedge_grace_s = hedge_grace_s
+
+    def _tagged(self, query: Any) -> Any:
+        """The query as it rides the bus: wrapped with this job's
+        program tag when the job is co-hosted, verbatim otherwise."""
+        if self.program is None:
+            return query
+        from rafiki_tpu.tenancy.hosting import wrap_query
+
+        return wrap_query(self.program, query)
 
     def live_workers(self) -> List[str]:
         """Reap corpses, then return the fresh-leased worker set — or,
@@ -177,8 +194,9 @@ class Predictor:
         for query in queries:
             qid = uuid.uuid4().hex
             qids.append(qid)
+            tagged = self._tagged(query)
             for w in workers:
-                self.bus.add_query(w, qid, query)
+                self.bus.add_query(w, qid, tagged)
         # One deadline for the whole batch: a dead-but-registered worker
         # costs at most timeout_s total, not timeout_s per query, and
         # partial gathers still ensemble whatever arrived. Past the
@@ -280,7 +298,10 @@ class Predictor:
         telemetry.inc("predictor.queries", n)
         telemetry.observe("predictor.fanout_workers", len(workers))
         qid = uuid.uuid4().hex
-        payload = {BATCH_KEY: list(queries)}
+        # Tag INNER queries, not the batch envelope: the worker expands
+        # BATCH_KEY before model.predict, so per-query program tags are
+        # what a ProgramHost actually sees.
+        payload = {BATCH_KEY: [self._tagged(q) for q in queries]}
         for w in workers:
             self.bus.add_query(w, qid, payload)
         t_gather = time.monotonic()

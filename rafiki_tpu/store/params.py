@@ -67,6 +67,14 @@ class ParamsStore:
     def exists(self, params_id: str) -> bool:
         return self._path(params_id).exists()
 
+    def size(self, params_id: str) -> int:
+        """On-disk byte size of the params blob (0 when absent) — the
+        HBM residency charge estimate for co-hosted serving."""
+        try:
+            return self._path(params_id).stat().st_size
+        except OSError:
+            return 0
+
     def delete(self, params_id: str) -> None:
         self._path(params_id).unlink(missing_ok=True)
 

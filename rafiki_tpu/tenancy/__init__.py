@@ -1,0 +1,37 @@
+"""Multi-tenant serving fabric (docs/multitenancy.md).
+
+Rafiki's original premise is multi-user MLaaS — many concurrent jobs
+from many users sharing one cluster — yet until this package every
+inference job got dedicated workers and the gateway admitted requests
+first-come-first-served. The tenancy layer makes the serving chain
+tenant-aware end to end:
+
+* :mod:`qos` — QoS classes (``gold``/``std``/``batch``: weight,
+  deadline tier, p99 budget) and the tenant→tier directory, all
+  ``RAFIKI_TENANT_*`` knobs.
+* :mod:`admission` — weighted-fair admission across tenants with
+  per-tenant queue/inflight quotas: one tenant's spike sheds THAT
+  tenant, never starves another.
+* :mod:`accounting` — bounded per-tenant admit/shed/latency/burn
+  accounting (``serving.tenant.*`` metrics, ``tenant/*`` journals).
+* :mod:`residency` — LRU program residency against an HBM byte
+  budget with journaled activate/evict (``tenancy/residency``).
+* :mod:`hosting` — ``ProgramHost``: one worker process serving many
+  models behind the residency manager (the PR 13 StackedEnsemble
+  generalization from k-trials-one-job to k-models-many-jobs).
+* :mod:`arbiter` — fleet-level arbitration: the autoscale tenant
+  lane's pressure function and the twin-gated admission of NEW jobs
+  (``tenancy/arbiter`` journals).
+"""
+
+from rafiki_tpu.tenancy.qos import (  # noqa: F401
+    ANON_TENANT, QosClass, TenantDirectory, DEFAULT_TIER, TIERS)
+from rafiki_tpu.tenancy.accounting import (  # noqa: F401
+    BoundedTenantMap, TenantAccounting)
+from rafiki_tpu.tenancy.admission import TenantAdmissionController  # noqa: F401
+from rafiki_tpu.tenancy.residency import ResidencyManager  # noqa: F401
+from rafiki_tpu.tenancy.hosting import (  # noqa: F401
+    PROGRAM_KEY, ProgramHost, ProgramSpec, wrap_query)
+from rafiki_tpu.tenancy.fabric import TenantFabric  # noqa: F401
+from rafiki_tpu.tenancy.arbiter import (  # noqa: F401
+    JobAdmissionGate, tenant_pressure)

@@ -28,9 +28,17 @@ from rafiki_tpu.predictor.predictor import BATCH_KEY
 
 class InferenceWorker:
     def __init__(self, bus, job_id: str, worker_id: str, model: BaseModel,
-                 batch_size: int = 64, stop_event: Optional[threading.Event] = None):
+                 batch_size: int = 64, stop_event: Optional[threading.Event] = None,
+                 extra_job_ids: Optional[List[str]] = None):
         self.bus = bus
         self.job_id = job_id
+        # Co-hosted serving (docs/multitenancy.md): one worker process
+        # can serve SEVERAL jobs' models behind a ProgramHost. The
+        # worker registers (and heartbeats) under every co-hosted job
+        # id with the SAME worker id — each job's predictor fans out to
+        # the same queue, and the program tag on each query routes it.
+        self.job_ids = [job_id] + [j for j in (extra_job_ids or [])
+                                   if j != job_id]
         self.worker_id = worker_id
         self.model = model
         self.batch_size = batch_size
@@ -60,12 +68,14 @@ class InferenceWorker:
         exactly the signal the predictor's max_age_s filter consumes."""
         while not self._stop.wait(self.HEARTBEAT_S):
             try:
-                self.bus.heartbeat(self.job_id, self.worker_id)
+                for job_id in self.job_ids:
+                    self.bus.heartbeat(job_id, self.worker_id)
             except Exception:  # manager teardown mid-beat: exit quietly
                 return
 
     def run(self) -> None:
-        self.bus.add_worker(self.job_id, self.worker_id)
+        for job_id in self.job_ids:
+            self.bus.add_worker(job_id, self.worker_id)
         threading.Thread(target=self._beat, name=f"beat-{self.worker_id}",
                          daemon=True).start()
         try:
@@ -148,7 +158,8 @@ class InferenceWorker:
                         self.bus.put_prediction(qid, self.worker_id, pred,
                                                 hops=chain)
         finally:
-            self.bus.remove_worker(self.job_id, self.worker_id)
+            for job_id in self.job_ids:
+                self.bus.remove_worker(job_id, self.worker_id)
             self.drained.set()
 
     def _predict(self, queries: List[Any]) -> List[Any]:

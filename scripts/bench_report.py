@@ -123,6 +123,20 @@ RESUME_METRICS = {
     "duplicate_claims": "lower",
 }
 
+#: Multi-tenant serving rounds (``--tenants``): TENANT_r*.json
+#: artifacts from ``bench_serving.py --tenants`` (docs/multitenancy.md).
+#: The gold tenant's tail and shed rate are the isolation headline —
+#: the protected tenant must not regress when the batch aggressor's
+#: skewed load grows — while batch_qps guards the other direction:
+#: proportional share means the aggressor still progresses, so a
+#: "fix" that simply starves batch also fails the gate.
+TENANT_METRICS = {
+    "gold_p99_ms": "lower",
+    "gold_shed_rate": "lower",
+    "batch_qps": "higher",
+    "qps": "higher",
+}
+
 #: Metrics where 0 is a legitimate measurement, not "did not run" —
 #: a clean serving round genuinely sheds nothing, a 1-worker round
 #: has zero fan-out cost, a perfectly calibrated twin has zero
@@ -132,7 +146,8 @@ RESUME_METRICS = {
 ZERO_OK = {"shed_rate", "ensemble_fanout_cost_ms", "p50_err", "p99_err",
            "tph_err", "wall_err",
            "regret", "advisor_lift", "dedup_ratio",
-           "trials_salvaged", "trials_restarted", "duplicate_claims"}
+           "trials_salvaged", "trials_restarted", "duplicate_claims",
+           "gold_shed_rate"}
 
 #: Metrics that are legitimately signed: a GP that *hurt* the sweep
 #: has negative lift, and that is a measurement the trend must carry,
@@ -288,6 +303,17 @@ def resume_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
             if payload.get(k) is not None}
 
 
+def tenant_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """The multi-tenant block: ``bench_serving.py --tenants`` artifacts
+    carry the flat gold_*/batch_* headline keys at top level. Error
+    rounds yield nothing — a run that never isolated anyone is no-data,
+    not a zero-shed round."""
+    if not isinstance(payload, dict) or payload.get("error"):
+        return {}
+    return {k: payload.get(k) for k in TENANT_METRICS
+            if payload.get(k) is not None}
+
+
 def health_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     """The ``detail.health`` numerics block (docs/health.md), when the
     artifact carries one. Trended as ADVISORY context — a round with
@@ -388,15 +414,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="trend crash-recovery rounds (RESUME_r*.json "
                         "default glob, recovery_wall_s/restarts/duplicate "
                         "claims lower, salvaged trials higher)")
+    p.add_argument("--tenants", action="store_true",
+                   help="trend multi-tenant serving rounds "
+                        "(TENANT_r*.json default glob, gold tail/shed "
+                        "lower-better, batch qps higher-better)")
     args = p.parse_args(argv)
 
     if sum((args.serving, args.twin, args.train_twin, args.sweep,
-            args.scale, args.store, args.resume)) > 1:
+            args.scale, args.store, args.resume, args.tenants)) > 1:
         print(json.dumps(
             {"error": "--serving, --twin, --train-twin, --sweep, --scale, "
-                      "--store and --resume are exclusive"}))
+                      "--store, --resume and --tenants are exclusive"}))
         return 2
-    if args.resume:
+    if args.tenants:
+        metric_set, headline_fn = TENANT_METRICS, tenant_headline_of
+        pattern = "TENANT_r*.json"
+    elif args.resume:
         metric_set, headline_fn = RESUME_METRICS, resume_headline_of
         pattern = "RESUME_r*.json"
     elif args.scale:
@@ -440,7 +473,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         "schema_version": REPORT_SCHEMA_VERSION,
         "tolerance": args.tolerance,
         "n_rounds": len(rounds),
-        "mode": ("resume" if args.resume
+        "mode": ("tenants" if args.tenants
+                 else "resume" if args.resume
                  else "scale" if args.scale
                  else "store" if args.store
                  else "sweep" if args.sweep

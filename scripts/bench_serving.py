@@ -16,6 +16,11 @@ Three modes:
     spawned processes on the multiprocess bus, so the hop waterfall
     crosses >=3 pids (scripts/serving_obs_smoke.py drives this).
 
+``--tenants`` runs a skewed two-tenant closed loop (a gold tenant vs a
+``--skew``x batch aggressor) against a tenant-aware gateway and emits
+per-tenant p50/p99/shed plus the TENANT_r*.json headline keys
+(docs/multitenancy.md).
+
 ``--route`` picks the serving shape (docs/serving.md): ``replicated``
 (default) is the k-replica fan-out — one stub worker per trial, every
 request fanned to all of them; ``stacked`` is the collapsed route —
@@ -313,6 +318,107 @@ def run_smoke_mode(args, route="replicated"):
             manager.shutdown()
 
 
+def run_tenants_mode(args):
+    """Skewed two-tenant closed loop against a tenant-aware gateway.
+
+    A gold tenant at 1x clients and a batch tenant at ``--skew``x
+    clients share one gateway built over a TenantFabric — weighted
+    admission, per-tenant quotas, per-tenant accounting. The artifact
+    carries a per-tenant latency/shed report plus flat headline keys
+    (``gold_p99_ms``, ``gold_shed_rate``, ``batch_qps``) for the
+    TENANT_r*.json trend gate in bench_report --tenants: the number
+    that must not regress is the PROTECTED tenant's tail while the
+    aggressor keeps making proportional progress.
+    """
+    from werkzeug.test import Client
+
+    from rafiki_tpu.bus import InProcBus
+    from rafiki_tpu.gateway import Gateway, GatewayConfig
+    from rafiki_tpu.predictor import Predictor
+    from rafiki_tpu.predictor.app import PredictorApp
+    from rafiki_tpu.tenancy import TenantDirectory, TenantFabric
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    GOLD, BATCH = "gold_t", "batch_t"
+    stop = threading.Event()
+    bus = InProcBus()
+    threads = []
+    for i in range(args.workers):
+        w = InferenceWorker(bus, "bench", f"tw{i}",
+                            _StubModel(args.service_ms), stop_event=stop)
+        th = threading.Thread(target=w.run, daemon=True)
+        threads.append(th)
+        th.start()
+    deadline = time.monotonic() + 10
+    while len(bus.get_workers("bench")) < args.workers:
+        if time.monotonic() > deadline:
+            raise RuntimeError("bench workers never registered")
+        time.sleep(0.005)
+
+    fabric = TenantFabric(TenantDirectory(
+        tiers={GOLD: "gold", BATCH: "batch"}))
+    predictor = Predictor(bus, "bench", timeout_s=args.deadline_s)
+    gateway = Gateway(predictor, GatewayConfig(
+        max_inflight=args.max_inflight, max_queue=args.max_queue,
+        min_replies=1, hedge_grace_s=0.02), tenancy=fabric)
+    wsgi = Client(PredictorApp(gateway))
+    payload = {"queries": [[1.0]] * args.queries_per_request,
+               "deadline_s": args.deadline_s}
+
+    recorders = {GOLD: Recorder(), BATCH: Recorder()}
+
+    def _post_as(tenant):
+        def post(p):
+            return wsgi.post("/predict", json=p,
+                             headers={"X-Rafiki-Tenant": tenant}
+                             ).status_code
+        return post
+
+    clients = (
+        [ClosedLoopClient(_post_as(GOLD), args.requests_per_client,
+                          payload, recorders[GOLD].record)
+         for _ in range(args.clients)]
+        + [ClosedLoopClient(_post_as(BATCH), args.requests_per_client,
+                            payload, recorders[BATCH].record)
+           for _ in range(args.clients * args.skew)])
+    pool = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    t0 = time.monotonic()
+    try:
+        for th in pool:
+            th.start()
+        for th in pool:
+            th.join()
+        # lint: disable=RF007 — the delta IS the datum: load wall-clock, the per-tenant qps denominator
+        elapsed = time.monotonic() - t0
+        gateway.drain(timeout=5.0)  # flushes the tenant/summary journal
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=2)
+
+    tiers = {GOLD: "gold", BATCH: "batch"}
+    tenants = {t: dict(recorders[t].report(elapsed), tier=tiers[t])
+               for t in (GOLD, BATCH)}
+    total = sum(tenants[t]["requests"] for t in tenants)
+    report = {
+        "mode": "smoke-tenants",
+        "skew": args.skew,
+        "tenants": tenants,
+        "requests": total,
+        "ok": sum(tenants[t]["ok"] for t in tenants),
+        "shed": sum(tenants[t]["shed"] for t in tenants),
+        "errors": sum(tenants[t]["errors"] for t in tenants),
+        "qps": round(total / elapsed, 2) if elapsed else None,
+        # Flat headline keys for the TENANT_r*.json polarity gate.
+        "gold_p50_ms": tenants[GOLD]["p50_ms"],
+        "gold_p99_ms": tenants[GOLD]["p99_ms"],
+        "gold_shed_rate": tenants[GOLD]["shed_rate"],
+        "batch_p99_ms": tenants[BATCH]["p99_ms"],
+        "batch_qps": tenants[BATCH]["qps"],
+    }
+    return report
+
+
 def main(argv=None):
     # Platform pin FIRST: this process may import jax transitively via
     # the worker/model stack, and the image's sitecustomize would
@@ -342,6 +448,15 @@ def main(argv=None):
     ap.add_argument("--pin-trace", default=None,
                     help="send one extra request under this trace id "
                          "after the load (obs waterfall target)")
+    ap.add_argument("--tenants", action="store_true",
+                    help="skewed two-tenant run against a tenant-aware "
+                         "gateway: per-tenant p50/p99/shed plus the "
+                         "TENANT_r*.json headline keys "
+                         "(docs/multitenancy.md)")
+    ap.add_argument("--skew", type=int, default=3,
+                    help="batch-tenant client multiple in --tenants "
+                         "mode (gold gets --clients, batch gets "
+                         "--clients * skew)")
     ap.add_argument("--clients", type=int, default=4)
     ap.add_argument("--requests-per-client", type=int, default=25)
     ap.add_argument("--queries-per-request", type=int, default=4)
@@ -371,7 +486,10 @@ def main(argv=None):
         rep["ensemble_fanout_cost_ms"] = fanout_ms
         return rep
 
-    if args.url and not args.smoke:
+    if args.tenants:
+        report = run_tenants_mode(args)
+        unhealthy = [report]
+    elif args.url and not args.smoke:
         report = run_url_mode(args)
         report["mode"] = "url"
         hops, fanout_ms = _hops_block()

@@ -96,6 +96,14 @@ env JAX_PLATFORMS=cpu python scripts/resume_smoke.py > /tmp/_resume_smoke.json \
 # (docs/twin.md). ~30s.
 env JAX_PLATFORMS=cpu python scripts/train_twin_smoke.py > /tmp/_train_twin_smoke.json \
   || { echo "TIER1 TRAIN TWIN SMOKE FAILED (see /tmp/_train_twin_smoke.json)"; exit 1; }
+# Tenancy smoke: one worker must serve two distinct models through a
+# journaled LRU residency swap under an HBM budget, the
+# noisy-neighbor-shed scenario must PASS weighted (victim p99 inside
+# its gold budget, aggressor sheds tenant_quota), and the doctored
+# RAFIKI_TENANT_UNWEIGHTED=1 polarity must FAIL the victim-p99 gate
+# specifically (docs/multitenancy.md). ~20s.
+env JAX_PLATFORMS=cpu python scripts/tenancy_smoke.py > /tmp/_tenancy_smoke.json \
+  || { echo "TIER1 TENANCY SMOKE FAILED (see /tmp/_tenancy_smoke.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

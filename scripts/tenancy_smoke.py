@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Tenancy CI smoke: isolation + co-hosting, both polarities
+(docs/multitenancy.md).
+
+Three legs, all journal-evidenced:
+
+  * **Co-hosting**: ONE InferenceWorker serves TWO distinct models
+    (jobA/jobB) behind a ProgramHost whose ResidencyManager budget fits
+    only one — every cross-program query forces an LRU swap, and the
+    swaps must appear in the ``tenancy/residency`` journal. This is the
+    acceptance criterion "one worker process demonstrably serves >= 2
+    distinct models with an LRU residency swap journaled under an HBM
+    budget", at CPU size.
+  * **Isolation holds**: the ``noisy-neighbor-shed`` chaos scenario
+    must PASS — weighted admission + per-tenant quotas keep the gold
+    victim's p99 inside budget while the flooding batch aggressor sheds
+    ``tenant_quota``.
+  * **Doctored polarity**: the SAME scenario under
+    ``RAFIKI_TENANT_UNWEIGHTED=1`` (quota off, arbitration degraded to
+    global FIFO — the pre-tenancy gateway) must FAIL, and must fail
+    the ``victim_p99_within_budget`` check specifically: a gate that
+    cannot catch unfair admission is not a gate.
+
+The chaos CLI exits 0 even on scenario FAIL (it is a reporter); this
+smoke therefore drives the runner's Python API and reads the per-check
+verdicts off the ScenarioReport, never the exit code.
+
+Output: one JSON object on stdout; exit 0 only when every leg holds —
+this is a CI gate (scripts/check_tier1.sh), not just a number printer.
+~20s (the doctored leg is slow BY DESIGN: the victim really does queue
+behind the whole flood).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCENARIO = "noisy-neighbor-shed"
+UNWEIGHTED_VAR = "RAFIKI_TENANT_UNWEIGHTED"
+P99_CHECK = "victim_p99_within_budget"
+
+
+class _TagModel:
+    """Distinct, recognizable models: program 'A' answers 'A:<q>'."""
+
+    def __init__(self, tag: str):
+        self.tag = tag
+
+    def predict(self, queries):
+        return [f"{self.tag}:{q}" for q in queries]
+
+
+def _cohost_leg(checks: list) -> None:
+    """One worker, two models, a budget that fits only one."""
+    from rafiki_tpu.bus import InProcBus
+    from rafiki_tpu.obs import journal as journal_mod
+    from rafiki_tpu.obs.journal import journal
+    from rafiki_tpu.predictor.predictor import Predictor
+    from rafiki_tpu.tenancy.hosting import ProgramHost, ProgramSpec
+    from rafiki_tpu.tenancy.residency import ResidencyManager
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    with tempfile.TemporaryDirectory(prefix="tenancy-smoke-") as td:
+        log_dir = Path(td) / "obs"
+        journal.configure(log_dir, role="smoke")
+        try:
+            # 100-byte budget vs two 80-byte programs: every program
+            # switch MUST evict the other — the LRU swap is forced,
+            # not incidental.
+            residency = ResidencyManager(budget_bytes=100)
+            host = ProgramHost(
+                [ProgramSpec("jobA", lambda: _TagModel("A"), 80),
+                 ProgramSpec("jobB", lambda: _TagModel("B"), 80)],
+                residency=residency)
+            bus = InProcBus()
+            stop = threading.Event()
+            worker = InferenceWorker(bus, "jobA", "w0", host,
+                                     stop_event=stop,
+                                     extra_job_ids=["jobB"])
+            th = threading.Thread(target=worker.run, daemon=True)
+            th.start()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and (
+                    "w0" not in bus.get_workers("jobA")
+                    or "w0" not in bus.get_workers("jobB")):
+                time.sleep(0.01)
+            checks.append({
+                "name": "one_worker_registered_under_both_jobs",
+                "ok": (bus.get_workers("jobA") == ["w0"]
+                       and bus.get_workers("jobB") == ["w0"]),
+                "detail": f"jobA={bus.get_workers('jobA')} "
+                          f"jobB={bus.get_workers('jobB')}"})
+            pa = Predictor(bus, "jobA", timeout_s=5.0, program="jobA")
+            pb = Predictor(bus, "jobB", timeout_s=5.0, program="jobB")
+            answers = [pa.predict(["x"])[0], pb.predict(["y"])[0],
+                       pa.predict(["z"])[0]]
+            stop.set()
+            th.join(timeout=5)
+            host.destroy()
+            checks.append({
+                "name": "both_models_served_through_one_worker",
+                "ok": answers == ["A:x", "B:y", "A:z"],
+                "detail": f"answers={answers}"})
+            recs = journal_mod.read_dir(log_dir)
+            events = [r.get("event") for r in recs
+                      if r.get("kind") == "tenancy"
+                      and r.get("name") == "residency"]
+            checks.append({
+                "name": "lru_swap_journaled",
+                "ok": events.count("activate") >= 3
+                and events.count("evict") >= 2,
+                "detail": f"residency events={events}"})
+            over = [r for r in recs if r.get("kind") == "tenancy"
+                    and r.get("name") == "residency"
+                    and r.get("used_bytes", 0) > 100]
+            checks.append({
+                "name": "hbm_budget_never_exceeded",
+                "ok": not over,
+                "detail": f"{len(over)} records over the 100B budget"})
+        finally:
+            journal.close()
+
+
+def _scenario_leg(checks: list, doctored: bool) -> dict:
+    from rafiki_tpu.chaos.runner import format_report, run_scenario
+
+    saved = os.environ.get(UNWEIGHTED_VAR)
+    if doctored:
+        os.environ[UNWEIGHTED_VAR] = "1"
+    else:
+        os.environ.pop(UNWEIGHTED_VAR, None)
+    try:
+        report = run_scenario(SCENARIO)
+    finally:
+        if saved is None:
+            os.environ.pop(UNWEIGHTED_VAR, None)
+        else:
+            os.environ[UNWEIGHTED_VAR] = saved
+    p99 = next((c for c in report.checks if c.name == P99_CHECK), None)
+    if doctored:
+        # The doctored gate is SPECIFIC: unweighted admission must be
+        # caught by the victim-p99 check, not by some incidental error.
+        checks.append({
+            "name": "doctored_unweighted_fails_victim_p99_gate",
+            "ok": (not report.passed and report.error is None
+                   and p99 is not None and not p99.ok),
+            "detail": (p99.detail if p99 is not None
+                       else "victim_p99 check missing")})
+    else:
+        checks.append({
+            "name": "weighted_isolation_scenario_passes",
+            "ok": report.passed,
+            "detail": "" if report.passed else format_report(report)})
+    return report.to_dict()
+
+
+def main() -> int:
+    from rafiki_tpu.utils.backend import honor_env_platform
+
+    honor_env_platform()
+
+    t0 = time.monotonic()
+    checks: list = []
+    _cohost_leg(checks)
+    weighted = _scenario_leg(checks, doctored=False)
+    doctored = _scenario_leg(checks, doctored=True)
+    out = {
+        "checks": checks,
+        "passed": sum(1 for c in checks if c["ok"]),
+        "failed": sum(1 for c in checks if not c["ok"]),
+        # lint: disable=RF007 — smoke artifact wall-clock
+        "wall_s": round(time.monotonic() - t0, 2),
+        "weighted_report": weighted,
+        "doctored_report": doctored,
+    }
+    print(json.dumps(out, indent=2))
+    for c in checks:
+        if not c["ok"]:
+            print(f"FAIL {c['name']}: {c['detail']}", file=sys.stderr)
+    return 1 if out["failed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

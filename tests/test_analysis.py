@@ -30,7 +30,8 @@ def _analyze_snippet(tmp_path, source, name="snippet.py", select=None):
 def test_all_builtin_checkers_registered():
     assert {"RF001", "RF002", "RF003", "RF004", "RF005", "RF006",
             "RF007", "RF008", "RF009", "RF010", "RF011",
-            "RF012", "RF013", "RF014", "RF015", "RF016"} <= set(REGISTRY)
+            "RF012", "RF013", "RF014", "RF015", "RF016",
+            "RF017"} <= set(REGISTRY)
 
 
 # ---------------------------------------------------------------------------
@@ -1217,3 +1218,91 @@ def test_rf014_decisions_reader_closes_control_plane_records(tmp_path):
                     yield "twin", r.get("plan")
         """}), select=["RF014"])
     assert r.unsuppressed == []
+
+
+# ---------------------------------------------------------------------------
+# RF017 unbounded-per-tenant-state
+# ---------------------------------------------------------------------------
+
+
+RF017_BAD = """
+    from rafiki_tpu.tenancy import TenantFabric
+
+    class Ledger:
+        def __init__(self):
+            self.stats = {}
+            self.queues = {}
+
+        def note(self, tenant_id, v):
+            self.stats[tenant_id] = v
+            self.queues.setdefault(tenant_id, []).append(v)
+    """
+
+
+def test_rf017_fires_on_tenant_keyed_writes(tmp_path):
+    r = _analyze_snippet(tmp_path, RF017_BAD, select=["RF017"])
+    found = [f for f in r.unsuppressed if f.checker_id == "RF017"]
+    assert len(found) == 2  # the Store subscript AND the setdefault
+    assert all("BoundedTenantMap" in f.message for f in found)
+
+
+def test_rf017_scoped_to_tenancy_touching_modules(tmp_path):
+    # The identical leak WITHOUT a rafiki_tpu.tenancy import is out of
+    # scope: unbounded-keyed-state is only a wire-driven leak where
+    # tenant ids actually flow.
+    r = _analyze_snippet(tmp_path, RF017_BAD.replace(
+        "from rafiki_tpu.tenancy import TenantFabric", "import os"),
+        select=["RF017"])
+    assert "RF017" not in _ids(r)
+
+
+def test_rf017_quiet_with_eviction_or_cap(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        from rafiki_tpu.tenancy import TenantFabric
+
+        class Pruned:
+            def __init__(self):
+                self.stats = {}
+
+            def note(self, tenant_id, v):
+                self.stats[tenant_id] = v
+                while len(self.stats) > 64:
+                    self.stats.pop(next(iter(self.stats)))
+        """, select=["RF017"])
+    assert "RF017" not in _ids(r)
+
+
+def test_rf017_quiet_on_non_tenant_keys(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        from rafiki_tpu.tenancy import TenantFabric
+
+        class ByReason:
+            def __init__(self):
+                self.shed = {}
+
+            def note(self, reason):
+                self.shed[reason] = self.shed.get(reason, 0) + 1
+        """, select=["RF017"])
+    assert "RF017" not in _ids(r)
+
+
+def test_rf017_justified_suppression_honored(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        from rafiki_tpu.tenancy import TenantFabric
+
+        class ConfigMap:
+            def __init__(self, raw):
+                self.tiers = {}
+                for tenant, tier in raw.items():
+                    # lint: disable=RF017 — construction-time config, not wire-keyed growth
+                    self.tiers[tenant] = tier
+        """, select=["RF017"])
+    assert "RF017" not in _ids(r)
+
+
+def test_rf017_current_tree_is_clean():
+    r = analyze_paths([os.path.join(REPO, "rafiki_tpu"),
+                       os.path.join(REPO, "bench.py"),
+                       os.path.join(REPO, "scripts")], select=["RF017"])
+    mine = [f for f in r.unsuppressed if f.checker_id == "RF017"]
+    assert mine == [], [f"{f.path}:{f.line}" for f in mine]

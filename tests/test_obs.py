@@ -245,8 +245,20 @@ _SNAPSHOT = {
     "ts": 1700000000.0,
     "counters": {"gateway.shed": 3, "bus.queries_added": 12.0,
                  "serving.microbatch.flush_size": 2,
-                 "gateway.blackout_retries": 1.0},
-    "gauges": {"bus.queue_depth": 2, "serving.qps": 18.0},
+                 "gateway.blackout_retries": 1.0,
+                 "serving.tenant.admitted": 20,
+                 "serving.tenant.shed": 5,
+                 "serving.tenant.shed_batch": 5,
+                 "tenant.accounting_evictions": 1,
+                 "tenancy.residency_hits": 7,
+                 "tenancy.residency_misses": 3,
+                 "tenancy.residency_evictions": 2,
+                 "tenancy.host_queries": 10.0,
+                 "tenancy.jobs_admitted": 2,
+                 "tenancy.jobs_rejected": 1},
+    "gauges": {"bus.queue_depth": 2, "serving.qps": 18.0,
+               "serving.tenant.burn": 0.4765,
+               "tenancy.residency_used_bytes": 160},
     "histograms": {
         "predictor.gather_s": {"count": 4, "sum": 0.5, "p50": 0.1,
                                "p90": 0.2, "p99": 0.25},
