@@ -713,6 +713,16 @@ def run_advisor_lift(sc: dict, detail: dict) -> None:
         "effective_trials_per_hour": round(
             n_scored / sweep_wall_s * 3600.0, 2) if sweep_wall_s else 0.0,
     }
+    # Curve-advisor plane (docs/early_kill.md): the probe sweep above
+    # never kills (no epoch loop), so these are 0 here — but headline
+    # runs under RAFIKI_CURVE_KILL pick up the session's counters, and
+    # bench_report --sweep trends them alongside the throughput claim.
+    from rafiki_tpu.obs.search.ledger import search_ledger
+
+    snap = search_ledger.snapshot()
+    for k in ("n_killed", "n_false_kills", "n_speculations",
+              "n_corrections"):
+        detail["search"][k] = snap.get(k, 0)
 
 
 # -- microbench: step throughput, MFU, advisor, dump ------------------------

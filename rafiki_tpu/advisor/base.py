@@ -130,6 +130,12 @@ class BaseAdvisor:
         # via _pending_add and read via _pending / _pending_dists; the
         # drain on feedback happens here so no engine can forget it.
         self._pending: List[np.ndarray] = []
+        # Speculative scores in flight: knobs_hash -> predicted score
+        # (advisor/speculative.py). Tracked here so feedback() can
+        # route the true score into a correction instead of a fresh
+        # observation; never touches `history` — best() only ever
+        # reports real scores.
+        self._speculative: Dict[str, float] = {}
 
     def propose(self) -> Knobs:
         with self._lock:
@@ -146,12 +152,39 @@ class BaseAdvisor:
 
     def feedback(self, score: float, knobs: Knobs) -> None:
         with self._lock:
+            predicted = self._speculative.pop(audit.knobs_hash(knobs),
+                                              None)
             self.history.append((dict(knobs), float(score)))
             if self._pending and self.space.d:
                 x = self.space.encode(knobs)
                 self._pending = [p for p in self._pending
                                  if not np.allclose(p, x, atol=1e-9)]
-            self._feedback(float(score), dict(knobs))
+            if predicted is not None:
+                self._correct(float(score), dict(knobs), predicted)
+            else:
+                self._feedback(float(score), dict(knobs))
+
+    def speculate(self, score: float, knobs: Knobs,
+                  fit: Optional[Dict] = None) -> None:
+        """Tell with a *predicted* score for a still-running trial
+        (advisor/speculative.py). The prediction enters the engine's
+        training set (``_speculate``) but NOT ``history``; when the
+        true score lands, ``feedback`` routes it into ``_correct`` and
+        the engine refits. Idempotent per knob assignment while the
+        speculation is outstanding."""
+        with self._lock:
+            h = audit.knobs_hash(knobs)
+            if h in self._speculative:
+                return
+            self._speculative[h] = float(score)
+            # A speculation supersedes the constant-liar damping for
+            # this point — the engine now has a real-ish value there.
+            if self._pending and self.space.d:
+                x = self.space.encode(knobs)
+                self._pending = [p for p in self._pending
+                                 if not np.allclose(p, x, atol=1e-9)]
+            self._speculate(float(score), dict(knobs))
+            audit.record_speculate(self, float(score), knobs, fit=fit)
 
     # -- constant-liar helpers (called under the lock) ----------------------
 
@@ -189,6 +222,20 @@ class BaseAdvisor:
 
     def _feedback(self, score: float, knobs: Knobs) -> None:
         audit.record_feedback(self, score, knobs)
+
+    def _speculate(self, score: float, knobs: Knobs) -> None:
+        """Engine hook: absorb a predicted score into the training set.
+        Default no-op — engines without a surrogate (random) have
+        nothing to speculate into; the base still journals the
+        speculation so rehydration sees a uniform record stream."""
+
+    def _correct(self, score: float, knobs: Knobs,
+                 predicted: float) -> None:
+        """Engine hook: the true score for a previously speculated
+        assignment. Default: journal the correction, then treat it as
+        a fresh observation (matches the no-op ``_speculate``)."""
+        audit.record_correct(self, knobs, predicted, score)
+        self._feedback(score, knobs)
 
 
 def make_advisor(knob_config: KnobConfig, kind: str = "gp", seed: int = 0,

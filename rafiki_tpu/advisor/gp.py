@@ -17,7 +17,7 @@ from __future__ import annotations
 import math
 import time
 import warnings
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -39,6 +39,12 @@ class GpAdvisor(BaseAdvisor):
         self._y: List[float] = []
         self._gp = None
         self._last_fit_s: Optional[float] = None
+        # Speculative training rows: knobs_hash -> index into _X/_y.
+        # Safe against the tail appends/deletes of _feedback and the
+        # constant-liar batch because speculative rows are never at
+        # the tail when those run (everything here happens under the
+        # base lock) and corrections replace y in place.
+        self._spec_idx: Dict[str, int] = {}
 
     def _propose(self) -> Knobs:
         if self.space.d == 0:
@@ -120,6 +126,39 @@ class GpAdvisor(BaseAdvisor):
         self._y.append(score)
         if len(self._X) >= max(2, min(self.n_initial, 4)):
             self._fit()
+        audit.record_feedback(self, score, knobs)
+
+    def _speculate(self, score: float, knobs: Knobs) -> None:
+        """Predicted score for a still-running trial enters the
+        training set as a provisional row (advisor/speculative.py);
+        ``_correct`` replaces its y in place when the truth lands. One
+        append + one conditional fit — the exact op shape of
+        ``_feedback`` — so a rehydration that replays speculations
+        after real observations lands on the same rng position as a
+        fresh advisor fed the same sequence (the byte-identity
+        contract, docs/early_kill.md)."""
+        self._spec_idx[audit.knobs_hash(knobs)] = len(self._X)
+        self._X.append(self.space.encode(knobs))
+        self._y.append(score)
+        if len(self._X) >= max(2, min(self.n_initial, 4)):
+            self._fit()
+
+    def _correct(self, score: float, knobs: Knobs,
+                 predicted: float) -> None:
+        """True score replaces the speculative row and the GP refits.
+        Journals both the correction (prediction error) and the
+        normal feedback record (closes the ledger meter)."""
+        idx = self._spec_idx.pop(audit.knobs_hash(knobs), None)
+        if idx is None:
+            # Speculation known to the base but never absorbed here
+            # (engine swapped mid-flight); degrade to a plain append.
+            audit.record_correct(self, knobs, predicted, score)
+            self._feedback(score, knobs)
+            return
+        self._y[idx] = score
+        if len(self._X) >= max(2, min(self.n_initial, 4)):
+            self._fit()
+        audit.record_correct(self, knobs, predicted, score)
         audit.record_feedback(self, score, knobs)
 
     def _fit(self) -> None:

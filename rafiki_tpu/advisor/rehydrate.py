@@ -21,6 +21,16 @@ observations would — which, with the GP's canonical-order fit, makes
 the first post-resume ``propose_batch`` byte-identical between a
 crashed-and-resumed sweep and an unfaulted one (the equivalence
 contract tests/test_recovery.py pins).
+
+Speculative scores in flight at the crash (``advisor/speculate``
+records with no later ``advisor/feedback`` for the hash — the
+correction that would have superseded them never landed) are replayed
+AFTER all real observations, sorted by hash, through the normal
+``speculate()`` path. The engine's speculate op has the same
+append+fit shape as feedback, so the rehydrated advisor's training
+set and rng position equal a fresh advisor fed the same (real, then
+speculative) sequences — byte-identical proposals even mid-speculation
+(docs/early_kill.md's rehydration contract).
 """
 
 from __future__ import annotations
@@ -64,6 +74,44 @@ def journal_observations(records: Sequence[Dict[str, Any]],
     return out
 
 
+def journal_speculations(records: Sequence[Dict[str, Any]],
+                         advisor_id: Optional[str] = None,
+                         exclude_hashes: Optional[set] = None,
+                         ) -> List[Tuple[Dict[str, Any], float, Optional[dict]]]:
+    """(knobs, predicted, fit) for every speculation still UNCORRECTED
+    in the journals: an ``advisor/speculate`` record whose hash has no
+    ``advisor/feedback`` record anywhere in the stream (a correction
+    or true score supersedes the speculation). Last prediction wins
+    per hash; sorted by hash like :func:`journal_observations` so the
+    replay order is independent of journal interleaving."""
+    spec_by_hash: Dict[str, Tuple[Dict[str, Any], float, Optional[dict]]] = {}
+    fed_hashes: set = set()
+    for r in records:
+        if r.get("kind") != "advisor":
+            continue
+        if advisor_id is not None and r.get("advisor_id") != advisor_id:
+            continue
+        if r.get("name") == "feedback" and r.get("knobs_hash"):
+            fed_hashes.add(r["knobs_hash"])
+        elif r.get("name") == "speculate" \
+                and isinstance(r.get("knobs"), dict):
+            try:
+                pred = float(r.get("predicted"))
+            except (TypeError, ValueError):
+                continue
+            spec_by_hash[r.get("knobs_hash")] = (
+                r["knobs"], pred,
+                r.get("fit") if isinstance(r.get("fit"), dict) else None)
+    out = []
+    for h in sorted(spec_by_hash):
+        if h in fed_hashes:
+            continue
+        if exclude_hashes and h in exclude_hashes:
+            continue
+        out.append(spec_by_hash[h])
+    return out
+
+
 def rehydrate_advisor(advisors: AdvisorService,
                       knob_config,
                       kind: str,
@@ -97,7 +145,16 @@ def rehydrate_advisor(advisors: AdvisorService,
                                     exclude_hashes=seen))
     for kn, score in obs:
         advisors.feedback(aid, score, kn)
+    # Real observations first, THEN speculations still in flight at the
+    # crash — same op order a fresh advisor would see, which is what
+    # keeps post-resume proposals byte-identical (module docstring).
+    scored = seen | {knobs_hash(kn) for kn, _ in obs}
+    specs = journal_speculations(journal_records, advisor_id=advisor_id,
+                                 exclude_hashes=scored)
+    for kn, pred, fit in specs:
+        advisors.speculate(aid, pred, kn, fit=fit)
     _journal.record("recovery", "rehydrated", advisor_id=aid,
                     job_id=job_id, engine=kind, n_observations=len(obs),
-                    n_from_store=len(seen), n_from_journal=len(obs) - len(seen))
+                    n_from_store=len(seen), n_from_journal=len(obs) - len(seen),
+                    n_speculations=len(specs))
     return aid

@@ -55,6 +55,13 @@ class AdvisorService:
     def feedback(self, advisor_id: str, score: float, knobs: Knobs) -> None:
         self.get(advisor_id).feedback(score, knobs)
 
+    def speculate(self, advisor_id: str, score: float, knobs: Knobs,
+                  fit: Optional[dict] = None) -> None:
+        """Tell with a predicted score for a still-running trial
+        (advisor/speculative.py); the true score lands later through
+        ``feedback`` and becomes a correction."""
+        self.get(advisor_id).speculate(score, knobs, fit=fit)
+
     def best(self, advisor_id: str) -> Optional[Tuple[Knobs, float]]:
         return self.get(advisor_id).best()
 

@@ -1,0 +1,211 @@
+"""RF018 unaudited-speculation.
+
+Curve-advisor finding (PR 19, docs/early_kill.md): the speculative-
+scoring plane keeps the GP's training rows honest through exactly
+three audited surfaces — ``_feedback`` (real score), ``_speculate``
+(predicted score, journaled so crash-resume can replay it), and
+``_correct`` (in-place replacement when the real score lands). A
+mutation of the GP training data (``self._X`` / ``self._y``) anywhere
+else bypasses the journal: the advisor's posterior diverges from what
+``advisor/*`` records can reconstruct, and the PR 15 rehydration
+contract (byte-identical post-resume proposals) silently breaks — the
+worst kind of break, because nothing fails until a crash-resume
+produces different knobs than the unfaulted run would have.
+
+Same story for kill decisions: a function that marks a trial killed
+without a lexically-reachable call into the audit layer produces a
+kill ``obs sweep`` cannot reconcile and ``search.kills`` never counts.
+
+Flagged inside ``rafiki_tpu/advisor/`` only:
+
+* a function outside the sanctioned surfaces (``__init__``,
+  ``_feedback``, ``_speculate``, ``_correct``, ``_propose_batch``,
+  ``_fit``) that mutates an attribute named ``_X`` or ``_y`` —
+  assignment, ``del``, augmented assignment, subscript store, or a
+  mutating method call (``append``/``pop``/``extend``/...);
+* a non-abstract function whose name contains ``kill`` that mutates
+  state (attribute or subscript store — i.e. it *decides*, it is not
+  a pure predicate like ``KillConfig.should_kill``) without calling a
+  name imported from ``rafiki_tpu.obs.journal`` or
+  ``rafiki_tpu.obs.search*``.
+
+Legitimate exceptions (a test shim, a migration helper that rebuilds
+rows from the journal itself) justify-suppress, stating which journal
+records make the mutation replayable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+from rafiki_tpu.analysis.checkers._ast_util import dotted_name
+
+#: The package whose speculation contract this checker enforces.
+SCOPE = "rafiki_tpu.advisor"
+
+#: Imports from these module prefixes taint a local name as
+#: "audit-capable" (same rule as RF011).
+AUDIT_MODULES = ("rafiki_tpu.obs.journal", "rafiki_tpu.obs.search")
+
+#: The only functions allowed to touch the GP training rows. Everything
+#: here either journals the mutation itself or (constant-liar batch,
+#: ``_fit``) operates on rows a journaled surface planted.
+TRAINING_DATA_SURFACES = frozenset({
+    "__init__", "_feedback", "_speculate", "_correct",
+    "_propose_batch", "_fit",
+})
+
+#: Attribute names that hold GP training data.
+TRAINING_ATTRS = frozenset({"_X", "_y"})
+
+#: List/dict methods that mutate their receiver.
+MUTATING_METHODS = frozenset({
+    "append", "extend", "insert", "pop", "remove", "clear",
+    "setdefault", "update",
+})
+
+
+def _audit_names(tree: ast.Module) -> Set[str]:
+    """Local aliases bound to the journal/audit layer (RF011's rule)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.startswith(AUDIT_MODULES):
+                for a in node.names:
+                    names.add(a.asname or a.name)
+            elif mod in ("rafiki_tpu.obs", "rafiki_tpu.obs.search"):
+                for a in node.names:
+                    full = f"{mod}.{a.name}"
+                    if full.startswith(AUDIT_MODULES):
+                        names.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith(AUDIT_MODULES):
+                    names.add(a.asname or a.name.split(".")[0])
+    return names
+
+
+def _is_training_attr(node: ast.AST) -> bool:
+    """``<anything>._X`` / ``<anything>._y`` (typically ``self.``)."""
+    return isinstance(node, ast.Attribute) and node.attr in TRAINING_ATTRS
+
+
+def _mutates_training_data(fn) -> List[ast.AST]:
+    """Statements in ``fn`` that mutate a ``_X``/``_y`` attribute."""
+    hits: List[ast.AST] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if _is_training_attr(t):
+                    hits.append(node)
+                elif (isinstance(t, ast.Subscript)
+                      and _is_training_attr(t.value)):
+                    hits.append(node)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if _is_training_attr(t) or (
+                        isinstance(t, ast.Subscript)
+                        and _is_training_attr(t.value)):
+                    hits.append(node)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in MUTATING_METHODS
+                    and _is_training_attr(f.value)):
+                hits.append(node)
+    return hits
+
+
+def _mutates_state(fn) -> bool:
+    """Any attribute or subscript store — the line between a kill
+    *decision* (marks something killed) and a pure predicate."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    return True
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    return True
+    return False
+
+
+def _body_sans_docstring(fn) -> List[ast.stmt]:
+    body = list(fn.body)
+    if (body and isinstance(body[0], ast.Expr)
+            and isinstance(body[0].value, ast.Constant)
+            and isinstance(body[0].value.value, str)):
+        body = body[1:]
+    return body
+
+
+def _calls_audit(fn, audit_names: Set[str]) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name and (name in audit_names
+                     or name.split(".")[0] in audit_names):
+            return True
+    return False
+
+
+def _functions(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+@register
+class UnauditedSpeculation(Checker):
+    id = "RF018"
+    name = "unaudited-speculation"
+    severity = "error"
+    rationale = ("GP training rows mutated outside the journaled "
+                 "feedback/speculate/correct surfaces, or a kill "
+                 "decision with no reachable audit call, break the "
+                 "crash-resume byte-identity contract — route the "
+                 "mutation through a sanctioned surface, or "
+                 "justify-suppress naming the journal records that "
+                 "make it replayable")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not ctx.module_name.startswith(SCOPE):
+            return []
+        audit_names = _audit_names(ctx.tree)
+        findings: List[Finding] = []
+        for fn in _functions(ctx.tree):
+            body = _body_sans_docstring(fn)
+            if all(isinstance(s, (ast.Raise, ast.Pass)) for s in body):
+                continue  # abstract hook: decides nothing
+            if fn.name not in TRAINING_DATA_SURFACES:
+                for hit in _mutates_training_data(fn):
+                    findings.append(self.finding(
+                        ctx, hit,
+                        f"`{fn.name}` mutates GP training data "
+                        f"(`_X`/`_y`) outside the journaled surfaces "
+                        f"({', '.join(sorted(TRAINING_DATA_SURFACES))})"
+                        f" — the posterior diverges from what "
+                        f"`advisor/*` records can replay, breaking "
+                        f"crash-resume byte-identity; route it through "
+                        f"_feedback/_speculate/_correct"))
+            if ("kill" in fn.name and _mutates_state(fn)
+                    and not _calls_audit(fn, audit_names)):
+                findings.append(self.finding(
+                    ctx, fn,
+                    f"`{fn.name}` decides a kill (mutates state) with "
+                    f"no lexically-reachable call into "
+                    f"rafiki_tpu.obs.search.audit — the kill never "
+                    f"reaches the journal, `obs sweep` cannot "
+                    f"reconcile it and `search.kills` undercounts; "
+                    f"call audit.record_kill(...) at the decision "
+                    f"site"))
+        return findings

@@ -82,6 +82,19 @@ KNOB_DOCS = {
         "attempts before the worker dies",
     "RAFIKI_COORDINATOR_ADDRESS": "jax distributed coordinator "
         "host:port (leader sets it for followers)",
+    "RAFIKI_CURVE_KILL": "learning-curve early-kill switch "
+        "(docs/early_kill.md); off by default — today's loops run "
+        "bit-exactly",
+    "RAFIKI_CURVE_KILL_MARGIN": "kill rule slack: a trial dies only "
+        "when its credible band's upper edge sits below best-so-far "
+        "minus this margin",
+    "RAFIKI_CURVE_KILL_MIN_OBS": "curve points required before the "
+        "extrapolator may condemn a trial",
+    "RAFIKI_CURVE_KILL_WARMUP": "epochs every trial is immune from "
+        "the early-kill rule",
+    "RAFIKI_CURVE_SPECULATE": "speculative scoring switch: feed the "
+        "advisor predicted scores for in-flight stragglers so "
+        "propose_batch never blocks (docs/early_kill.md)",
     "RAFIKI_DEVICE_DATASET_MAX_MB": "cap on device-resident dataset "
         "size before falling back to host streaming",
     "RAFIKI_EVENTS_DIR": "control-plane event bus directory "

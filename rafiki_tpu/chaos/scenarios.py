@@ -1458,6 +1458,229 @@ def host_loss_mid_sweep(tmp, check: CheckFn) -> None:
         check("wal_reconciles_clean", r.ok, r.summary())
 
 
+# A wider-lr sibling of ChaosFF for the early-kill scenario. The GP's
+# seed-0 warmup draws over this LINEAR lr range put {0.0127, 8.3e-4}
+# on chip 0 (global round-robin) and {0.0054, 3.4e-4} on chip 1: chip
+# 0's strong learner sets best-so-far ~0.95, and on the chaos-delayed
+# chip 1 the 3.4e-4 member's flat chance-level curve is condemned by
+# the predictor while its 0.0054 packmate's still-rising curve
+# survives and gets speculated. 8 epochs keep a multi-epoch window
+# open between the kill and pack completion for the state-triggered
+# SIGKILL below.
+EK_SOURCE = b"""
+from rafiki_tpu.model.base import JaxModel
+from rafiki_tpu.model.knobs import FixedKnob, FloatKnob
+from rafiki_tpu.models.ff import _Mlp
+
+class ChaosEkFF(JaxModel):
+    @staticmethod
+    def get_knob_config():
+        return {
+            "hidden_units": FixedKnob(24),
+            "learning_rate": FloatKnob(1e-5, 0.02, is_exp=False),
+            "batch_size": FixedKnob(32),
+            "epochs": FixedKnob(8),
+        }
+
+    def build_module(self, num_classes, input_shape):
+        return _Mlp(hidden_layers=1,
+                    hidden_units=int(self.knobs["hidden_units"]),
+                    num_classes=num_classes)
+"""
+
+
+def _uncorrected_spec_hashes(recs) -> set:
+    """Hashes with an ``advisor/speculate`` record and no
+    ``advisor/feedback`` record anywhere in the stream — the
+    speculations a crash would leave in flight."""
+    specs = {r.get("knobs_hash") for r in recs
+             if r.get("kind") == "advisor" and r.get("name") == "speculate"}
+    fed = {r.get("knobs_hash") for r in recs
+           if r.get("kind") == "advisor" and r.get("name") == "feedback"}
+    return specs - fed
+
+
+@scenario(
+    "early-kill-mid-pack-resume",
+    "SIGKILL the sweep supervisor at the worst curve-advisor moment: "
+    "a pack member was just early-killed by the learning-curve "
+    "predictor and its surviving packmates' speculative scores sit in "
+    "the GP uncorrected (the true scores never landed). Resume must "
+    "reconcile the WAL with zero double-claimed slots, rehydrate the "
+    "advisor from journals alone — real observations plus the "
+    "in-flight speculations, byte-identical proposals proven by "
+    "rehydrating twice from the same records — and finish the job "
+    "with the SAME best score and knob set as an unfaulted kill-on "
+    "run under the same seeds.",
+    spec="seed=37;worker.epoch:delay:delay=0.25:match=mesh-c1",
+    env={"RAFIKI_CHECKPOINT_EVERY": "1",
+         "RAFIKI_SUPERVISOR_HEARTBEAT_S": "0.2",
+         "RAFIKI_CURVE_KILL": "1",
+         "RAFIKI_CURVE_SPECULATE": "1",
+         # 5 observations before a verdict (the demo curves are noisy
+         # at 1/64 val granularity) and a wide margin so only the
+         # flat chance-level member is condemned, never its
+         # still-rising packmate.
+         "RAFIKI_CURVE_KILL_MIN_OBS": "5",
+         "RAFIKI_CURVE_KILL_MARGIN": "0.35"},
+)
+def early_kill_mid_pack_resume(tmp, check: CheckFn) -> None:
+    import json as _json
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    from rafiki_tpu.obs import journal as journal_mod
+    from rafiki_tpu.scheduler.wal import read_wal, reconcile, wal_path
+    from rafiki_tpu.store import MetaStore, ParamsStore
+
+    # Budget == GP n_initial: every claim is a seed-deterministic
+    # warmup proposal, so ONE unfaulted run is a complete reference
+    # (supervisor-kill-mid-sweep's trick). Chip 0 runs undelayed and
+    # sets best-so-far; the worker.epoch delay pinned to chip 1
+    # (match=mesh-c1) holds its pack mid-flight until best exists, so
+    # the doomed member's verdict reliably fires with a live packmate
+    # still training.
+    BUDGET, CHIPS, K = 4, 2, 2
+    fd = tmp / "faulted"
+    fd.mkdir(parents=True, exist_ok=True)
+    store = MetaStore(fd / "meta.sqlite3")
+    params = ParamsStore(fd / "params")
+    model = store.create_model("chaosekff", "IMAGE_CLASSIFICATION", None,
+                               EK_SOURCE, "ChaosEkFF")
+    job = _make_job(store, model, {"MODEL_TRIAL_COUNT": BUDGET})
+
+    # The SIGKILL cannot be tick-scheduled: the kill epoch arrives at
+    # machine-dependent times (jit compile contention). Watch the
+    # shared journal dir for the advisor/kill record AND an
+    # uncorrected advisor/speculate record (the backfill that follows
+    # the eviction speculates the surviving packmates), then kill the
+    # supervisor — crash state: just-killed member, speculations in
+    # flight.
+    argv = [sys.executable, "-m", "rafiki_tpu.scheduler.sweep_proc", "run",
+            "--db", str(store.path), "--params", str(params.directory),
+            "--job", job["id"], "--chips", str(CHIPS),
+            "--trials-per-chip", str(K), "--advisor", "gp",
+            "--advisor-kwargs", '{"n_initial": 4}']
+    child = subprocess.Popen(argv, env=_sweep_proc_env(),
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True)
+    log_dir = journal_mod.journal.log_dir
+    deadline = _time.monotonic() + 150.0
+    killed_seen = spec_in_flight = False
+    while _time.monotonic() < deadline and child.poll() is None:
+        recs = journal_mod.read_dir(log_dir)
+        killed_seen = any(r.get("kind") == "advisor"
+                          and r.get("name") == "kill" for r in recs)
+        spec_in_flight = bool(_uncorrected_spec_hashes(recs))
+        if killed_seen and spec_in_flight:
+            break
+        _time.sleep(0.02)
+    if child.poll() is None:
+        child.send_signal(signal.SIGKILL)
+    child.communicate(timeout=60)
+    check("kill_seen_before_crash", killed_seen,
+          "no advisor/kill record before timeout/exit")
+    check("speculation_in_flight_at_crash", spec_in_flight,
+          "no uncorrected advisor/speculate record at crash point")
+    check("supervisor_killed", child.returncode == -9,
+          f"run rc={child.returncode}")
+
+    # Byte-identity at the crash point: rehydrate the advisor TWICE
+    # from the same frozen journal snapshot + store rows (real scores
+    # first, then in-flight speculations — docs/early_kill.md) and the
+    # post-resume proposals must byte-match. This is the acceptance
+    # gate PR 15's replay contract owes the speculative plane.
+    from rafiki_tpu.advisor.rehydrate import rehydrate_advisor
+    from rafiki_tpu.advisor.service import AdvisorService
+    from rafiki_tpu.model.base import load_model_class
+
+    crash_recs = journal_mod.read_dir(log_dir)
+    sub = store.get_sub_train_jobs(job["id"])[0]
+    aid = sub.get("advisor_id")
+    check("advisor_id_persisted", bool(aid), f"sub row: {sub}")
+    model_row = store.get_model(sub["model_id"])
+    model_cls = load_model_class(model_row["model_file"],
+                                 model_row["model_class"])
+    completed = [t for t in store.get_trials_of_train_job(job["id"])
+                 if t["status"] == "COMPLETED" and t.get("score") is not None]
+    batches = []
+    for _ in range(2):
+        svc = AdvisorService()
+        rehydrate_advisor(svc, model_cls.get_knob_config(), kind="gp",
+                          advisor_id=aid, completed=completed,
+                          journal_records=crash_recs, seed=0,
+                          engine_kwargs={"n_initial": 4},
+                          job_id=job["id"])
+        batches.append(_json.dumps(svc.get(aid).propose_batch(K),
+                                   sort_keys=True))
+    check("rehydrated_proposals_byte_match", batches[0] == batches[1],
+          f"{batches[0][:200]} vs {batches[1][:200]}")
+
+    _time.sleep(0.5)
+    p2, summary = _sweep_proc("resume", store, params, job["id"],
+                              chips=CHIPS, trials_per_chip=K,
+                              env=_sweep_proc_env(chaos=False),
+                              stale_after_s=0.4)
+    check("resume_completed", p2.returncode == 0,
+          f"resume rc={p2.returncode}: {p2.stderr[-800:]}")
+    check("resume_adopted_orphans", summary.get("adopted", 0) >= 1, summary)
+
+    trials = store.get_trials_of_train_job(job["id"])
+    check("exact_trial_rows", len(trials) == BUDGET,
+          f"{len(trials)} rows for budget {BUDGET}")
+    check("no_duplicate_rows",
+          len({t["id"] for t in trials}) == len(trials), "duplicate ids")
+    bad = [t["id"] for t in trials
+           if t["status"] not in ("COMPLETED", "ERRORED")]
+    check("all_trials_terminal", not bad, f"non-terminal: {bad}")
+    check("killed_trial_errored",
+          any(t["status"] == "ERRORED" for t in trials),
+          "no ERRORED row — the pre-crash kill vanished on resume")
+
+    # WAL reconcile: zero double-claimed slots despite the kill +
+    # crash + adoption churn.
+    recs = read_wal(wal_path(store.path, job["id"]))
+    for s in store.get_sub_train_jobs(job["id"]):
+        r = reconcile(recs, store.get_trials_of_sub_train_job(s["id"]),
+                      sub=s, sub_id=s["id"])
+        check("wal_reconciles_clean", r.ok, r.summary())
+        check("no_double_claims",
+              all(n == 1 for n in r.claims.values()), r.summary())
+
+    # Unfaulted kill-on reference under the same seeds, own journal
+    # dir: same best score, same knob set, same kill.
+    rd = tmp / "reference"
+    rd.mkdir(parents=True, exist_ok=True)
+    rstore = MetaStore(rd / "meta.sqlite3")
+    rparams = ParamsStore(rd / "params")
+    rmodel = rstore.create_model("chaosekff", "IMAGE_CLASSIFICATION", None,
+                                 EK_SOURCE, "ChaosEkFF")
+    rjob = _make_job(rstore, rmodel, {"MODEL_TRIAL_COUNT": BUDGET})
+    renv = _sweep_proc_env(chaos=False)
+    renv["RAFIKI_LOG_DIR"] = str(rd / "obs")
+    p3, _ = _sweep_proc("run", rstore, rparams, rjob["id"], chips=CHIPS,
+                        trials_per_chip=K, env=renv, advisor="gp",
+                        advisor_kwargs='{"n_initial": 4}')
+    check("reference_completed", p3.returncode == 0,
+          f"reference rc={p3.returncode}: {p3.stderr[-500:]}")
+    rtrials = rstore.get_trials_of_train_job(rjob["id"])
+    best_f = max((t["score"] for t in trials
+                  if t["score"] is not None), default=None)
+    best_r = max((t["score"] for t in rtrials
+                  if t["score"] is not None), default=None)
+    check("best_score_matches_unfaulted",
+          best_f is not None and best_f == best_r,
+          f"faulted {best_f} vs unfaulted {best_r}")
+    knobs_f = sorted(_json.dumps(t["knobs"], sort_keys=True)
+                     for t in trials)
+    knobs_r = sorted(_json.dumps(t["knobs"], sort_keys=True)
+                     for t in rtrials)
+    check("knob_set_matches_unfaulted", knobs_f == knobs_r,
+          "resumed sweep explored different knobs than unfaulted run")
+
+
 # ---------------------------------------------------------------------------
 # Tenant isolation (docs/multitenancy.md)
 # ---------------------------------------------------------------------------

@@ -382,7 +382,8 @@ class JaxModel(BaseModel):
     @classmethod
     def train_packed(cls, models: List["JaxModel"], dataset_uri: str,
                      on_epoch=None, checkpoint_sink=None,
-                     backfill=None, on_evict=None) -> List[List[Dict[str, float]]]:
+                     backfill=None, on_evict=None,
+                     kill_predicate=None) -> List[List[Dict[str, float]]]:
         """Train k model instances as ONE vmapped program on one device.
 
         All models must share a packing_key (the caller buckets).
@@ -421,6 +422,15 @@ class JaxModel(BaseModel):
         epoch 0. When every remaining member leaves in the same round,
         the pack ends and members keep live slice views (the shared
         ``evaluate_packed`` fast path).
+
+        ``kill_predicate(model_index, epoch, metrics)``, when given, is
+        consulted at each member's epoch boundary (after the
+        divergence/budget/early-stop checks decline) and a True return
+        evicts the member with reason ``"killed"`` — the learning-curve
+        early-kill consumer (docs/early_kill.md). The caller owns all
+        bookkeeping (the worker's ``on_evict`` marks the trial errored
+        and routes the advisor's consolation feedback); default None =
+        behavior identical to before the parameter existed.
 
         Not supported in a pack (callers enforce; asserted here):
         meshes (the trial axis IS the parallelism), checkpoint-resume
@@ -531,6 +541,9 @@ class JaxModel(BaseModel):
                     leavers.append((j, mi, e, "finished"))
                 elif models[mi].should_stop_early(e, mts[j]):
                     leavers.append((j, mi, e, "early_stop"))
+                elif kill_predicate is not None \
+                        and kill_predicate(mi, e, mts[j]):
+                    leavers.append((j, mi, e, "killed"))
             for mi in slots:
                 epochs_done[mi] += 1
 
@@ -546,7 +559,8 @@ class JaxModel(BaseModel):
                     if reason == "diverged":
                         _health.note_eviction()
                     if on_evict is not None and reason in ("early_stop",
-                                                           "diverged"):
+                                                           "diverged",
+                                                           "killed"):
                         on_evict(mi, e, reason)
                 break
 

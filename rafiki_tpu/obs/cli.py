@@ -20,7 +20,9 @@ Subcommands (all read ``journal-*.jsonl*`` under ``--dir``, default
                    (docs/health.md)
     curves [id]    per-trial learning curves from the durable
                    ``trial/epoch_eval`` records; ``id`` prefix-matches
-                   trial ids (omit for every trial)
+                   trial ids (omit for every trial); ``--predicted``
+                   overlays the curve extrapolator's fit and credible
+                   band (docs/early_kill.md)
     replay <cap>   re-execute a divergence capsule and bit-verify the
                    reproduction; exit 0 iff the bad step reproduced
                    bit-exactly
@@ -312,10 +314,34 @@ def cmd_health(log_dir: str, as_json: bool) -> int:
     return 0
 
 
-def cmd_curves(log_dir: str, trial: Optional[str], as_json: bool) -> int:
+def _curve_overlay(rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Fit the same extrapolator the early-kill path uses (higher-is-
+    better ``acc`` points only) and return {fit, points} or None when
+    the trial has fewer than two accuracy observations."""
+    from rafiki_tpu.advisor import curve as curve_mod
+
+    from rafiki_tpu.advisor.speculative import DEFAULT_HORIZON
+
+    pts = [(int(r["epoch"]), float(r["acc"])) for r in rows
+           if r.get("epoch") is not None and r.get("acc") is not None]
+    if len(pts) < 2:
+        return None
+    fit = curve_mod.fit_curve(pts, max(DEFAULT_HORIZON,
+                                       max(e for e, _ in pts) + 1))
+    if fit is None:
+        return None
+    return {"fit": fit.to_record(),
+            "points": [{"epoch": e, "predicted": v}
+                       for e, v in curve_mod.predict_points(fit, pts)]}
+
+
+def cmd_curves(log_dir: str, trial: Optional[str], as_json: bool,
+               predicted: bool = False) -> int:
     """Learning-curve surfacing: replay the durable ``trial/epoch_eval``
     records into per-trial curves (the journal half of what the sqlite
-    trial log holds per process)."""
+    trial log holds per process). With ``--predicted``, overlay the
+    curve extrapolator's fit — the same prediction the early-kill path
+    audits a kill decision against (docs/early_kill.md)."""
     curves: Dict[str, List[Dict[str, Any]]] = {}
     for r in journal_mod.read_dir(log_dir):
         if r.get("kind") != "trial" or r.get("name") != "epoch_eval":
@@ -331,14 +357,24 @@ def cmd_curves(log_dir: str, trial: Optional[str], as_json: bool) -> int:
         return 1
     for tid in curves:
         curves[tid].sort(key=lambda r: (r.get("epoch", 0), r.get("ts", 0.0)))
+    overlays: Dict[str, Optional[Dict[str, Any]]] = {}
+    if predicted:
+        overlays = {tid: _curve_overlay(rows)
+                    for tid, rows in curves.items()}
     if as_json:
-        print(json.dumps({"trials": curves}, default=str))
+        doc: Dict[str, Any] = {"trials": curves}
+        if predicted:
+            doc["predicted"] = overlays
+        print(json.dumps(doc, default=str))
         return 0
     for tid, rows in sorted(curves.items()):
         last = rows[-1]
         packed = " [packed]" if last.get("packed") else ""
         print(f"trial {tid}{packed}: {len(rows)} epochs, "
               f"final score={last.get('score')}")
+        ov = overlays.get(tid)
+        fitted = ({p["epoch"]: p["predicted"] for p in ov["points"]}
+                  if ov else {})
         for r in rows:
             vals = []
             for k in ("loss", "acc"):
@@ -346,7 +382,19 @@ def cmd_curves(log_dir: str, trial: Optional[str], as_json: bool) -> int:
                     vals.append(f"{k}={r[k]:.6g}")
             if r.get("wall_s") is not None:
                 vals.append(f"wall={r['wall_s']:.3f}s")
+            if r.get("epoch") in fitted:
+                vals.append(f"fit={fitted[r['epoch']]:.6g}")
             print(f"  epoch {r.get('epoch'):>3}  " + " ".join(vals))
+        if predicted:
+            if ov is None:
+                print("  predicted: (needs >=2 acc observations)")
+            else:
+                f = ov["fit"]
+                print(f"  predicted final={f['predicted']:.6g} "
+                      f"band=±{f['band']:.6g} "
+                      f"[{f['lo']:.6g}, {f['hi']:.6g}] "
+                      f"family={f['family']} n_obs={f['n_obs']} "
+                      f"rmse={f['rmse']:.6g} horizon={f['horizon']}")
     return 0
 
 
@@ -854,6 +902,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="per-trial learning curves from the journals")
     sp.add_argument("trial", nargs="?", default=None,
                     help="trial id prefix (omit for all trials)")
+    sp.add_argument("--predicted", action="store_true",
+                    help="overlay the learning-curve extrapolator's fit "
+                         "(predicted final + credible band) on each curve")
     sp = sub.add_parser("replay",
                         help="re-execute a divergence capsule, bit-verify")
     sp.add_argument("capsule", help="path to a capsule-*.rcap file")
@@ -917,7 +968,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "health":
         return cmd_health(log_dir, args.json)
     if args.cmd == "curves":
-        return cmd_curves(log_dir, args.trial, args.json)
+        return cmd_curves(log_dir, args.trial, args.json, args.predicted)
     if args.cmd == "waterfall":
         return cmd_waterfall(log_dir, args.trace_id, args.json)
     if args.cmd == "tails":

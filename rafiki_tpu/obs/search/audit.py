@@ -6,7 +6,7 @@ errors when a ``_propose*``/``_feedback`` body in the advisor package
 returns without calling into this module, so a new engine cannot
 silently opt out of the audit trail.
 
-Three record shapes, all ``kind="advisor"``:
+Record shapes, all ``kind="advisor"``:
 
 ``advisor/propose``
     one chosen knob assignment: ``engine``/``advisor_id``/``job_id``/
@@ -22,6 +22,17 @@ Three record shapes, all ``kind="advisor"``:
 ``advisor/feedback``
     one observed score: ``knobs_hash``, ``score``, ``best_so_far``,
     history size, and whether the ledger saw the trial doomed.
+
+``advisor/predict`` / ``advisor/kill`` / ``advisor/speculate`` /
+``advisor/correct`` / ``advisor/false_kill``
+    the learning-curve plane (docs/early_kill.md): one extrapolator
+    fit consulted at an epoch boundary, one early-kill verdict, one
+    speculative score fed to the engine, one speculative score
+    replaced by the truth, one hindsight false-kill verdict. Each
+    carries the fit slice (``CurveFit.to_record``: family, decay,
+    n_obs, rmse, predicted, band, lo/hi, horizon) plus ``knobs_hash``
+    and the kill knobs in force, so PR 15's rehydration can replay
+    uncorrected speculations to byte-identical post-resume proposals.
 
 The join key is ``knobs_hash`` — a sha256 prefix over the canonical
 JSON of the full knob assignment. Workers already journal the same
@@ -124,4 +135,95 @@ def record_feedback(advisor: Any, score: float,
         doomed=doomed,
         n_observations=len(hist or ()),
         **_ident(advisor),
+    )
+
+
+# -- learning-curve plane (advisor/curve.py, docs/early_kill.md) -------------
+
+def record_predict(knobs: Dict[str, Any], fit: Dict[str, Any],
+                   epoch: int, best_so_far: Optional[float],
+                   trial_id: Optional[str] = None) -> None:
+    """Journal one extrapolator consultation at an epoch boundary.
+    ``fit`` is ``CurveFit.to_record()``."""
+    journal.record(
+        KIND, "predict",
+        knobs_hash=knobs_hash(knobs),
+        epoch=int(epoch),
+        best_so_far=best_so_far,
+        trial_id=trial_id,
+        **fit,
+    )
+
+
+def record_kill(knobs: Dict[str, Any], fit: Dict[str, Any],
+                epoch: int, best_so_far: float,
+                config: Dict[str, Any],
+                trial_id: Optional[str] = None) -> None:
+    """Journal one early-kill verdict: the fit that condemned the
+    trial plus the ``RAFIKI_CURVE_KILL*`` knobs in force (``config``),
+    so `obs sweep` can audit every kill against the rule that made it.
+    Callers still route the trial through ``note_doomed`` + the
+    consolation feedback — this record is the *why*, the ledger charge
+    is the *cost*."""
+    search_ledger.note_kill()
+    journal.record(
+        KIND, "kill",
+        knobs_hash=knobs_hash(knobs),
+        epoch=int(epoch),
+        best_so_far=float(best_so_far),
+        config=dict(config),
+        trial_id=trial_id,
+        **fit,
+    )
+
+
+def record_speculate(advisor: Any, predicted: float,
+                     knobs: Dict[str, Any],
+                     fit: Optional[Dict[str, Any]] = None) -> None:
+    """Journal one speculative score entering the engine's training
+    set. A later ``advisor/feedback`` for the same hash supersedes it
+    (the correction); rehydration replays only speculations with no
+    such feedback — see advisor/rehydrate.py."""
+    search_ledger.note_speculation()
+    journal.record(
+        KIND, "speculate",
+        knobs_hash=knobs_hash(knobs),
+        knobs=dict(knobs),
+        predicted=float(predicted),
+        fit=dict(fit) if fit else None,
+        n_observations=len(getattr(advisor, "history", ())),
+        **_ident(advisor),
+    )
+
+
+def record_correct(advisor: Any, knobs: Dict[str, Any],
+                   predicted: float, actual: float) -> None:
+    """Journal one speculative score replaced by the trial's true
+    score (the engine refits). The paired ``advisor/feedback`` record
+    carries the authoritative score; this one carries the error the
+    `obs sweep` prediction-quality roll-up wants."""
+    search_ledger.note_correction()
+    journal.record(
+        KIND, "correct",
+        knobs_hash=knobs_hash(knobs),
+        predicted=float(predicted),
+        actual=float(actual),
+        error=float(actual) - float(predicted),
+        **_ident(advisor),
+    )
+
+
+def record_false_kill(knobs: Dict[str, Any], killed_predicted: float,
+                      sibling_score: float, best_so_far: float) -> None:
+    """Hindsight verdict from a ground-truth checker (sweep smoke
+    re-runs each killed trial's knobs to completion): the sibling
+    finished above best-so-far, so the kill cost the search a
+    contender."""
+    search_ledger.note_false_kill()
+    journal.record(
+        KIND, "false_kill",
+        knobs_hash=knobs_hash(knobs),
+        killed_predicted=float(killed_predicted),
+        sibling_score=float(sibling_score),
+        best_so_far=float(best_so_far),
     )

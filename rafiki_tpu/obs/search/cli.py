@@ -90,9 +90,29 @@ def _print_sweep(doc: Dict[str, Any]) -> None:
             why += (f" log_ratio={acq.get('log_ratio')} "
                     f"pool={acq.get('pool')} n_good={acq.get('n_good')}")
         mark = " DOOMED" if p.get("doomed") else ""
+        if p.get("killed"):
+            fk = " FALSE-KILL" if p.get("false_kill") else ""
+            mark += (f" KILLED@e{p.get('kill_epoch')}"
+                     f"(pred={p.get('predicted_final')}){fk}")
+        elif p.get("speculated"):
+            mark += (" corrected" if p.get("corrected")
+                     else " SPECULATED")
+        if p.get("prediction_error") is not None:
+            mark += f" pred_err={p['prediction_error']}"
         print(f"  #{p['seq']:>3} {p.get('knobs_hash')} "
               f"score={p.get('score')}{mark} "
               f"trial={p.get('trial_id')}  [{why}]")
+    ca = doc.get("curve_advisor") or {}
+    if any(ca.get(k) for k in ("n_predicts", "n_kills",
+                               "n_speculations")):
+        print(f"  curve advisor: predicts={ca.get('n_predicts')} "
+              f"kills={ca.get('n_kills')} "
+              f"false_kills={ca.get('n_false_kills')} "
+              f"speculations={ca.get('n_speculations')} "
+              f"corrections={ca.get('n_corrections')} "
+              f"precision={ca.get('kill_precision')} "
+              f"recall={ca.get('kill_recall')} "
+              f"mean_abs_pred_err={ca.get('mean_abs_prediction_error')}")
     if doc.get("advisor_lift") is not None:
         print(f"  lift vs random: {doc['advisor_lift']} "
               f"[{doc.get('lift_ci_low')}, {doc.get('lift_ci_high')}] "

@@ -45,6 +45,14 @@ class SearchLedger:
             self.scored_wall_s = 0.0
             self.doomed_wall_s = 0.0
             self.best_score: Optional[float] = None
+            # Curve-advisor outcomes (docs/early_kill.md). Kills are a
+            # subset of doomed; false kills are hindsight verdicts a
+            # ground-truth checker (sweep smoke's sibling re-runs)
+            # establishes after the fact.
+            self.n_killed = 0
+            self.n_false_kills = 0
+            self.n_speculations = 0
+            self.n_corrections = 0
 
     # -- writes --------------------------------------------------------------
 
@@ -64,6 +72,38 @@ class SearchLedger:
         one."""
         with self._lock:
             self._doomed_hashes.add(knobs_hash)
+
+    def note_kill(self) -> None:
+        """One trial early-killed off a curve prediction. Callers pair
+        this with ``note_doomed`` — the kill counter explains *why* the
+        doomed bucket grew."""
+        with self._lock:
+            self.n_killed += 1
+            n = self.n_killed
+        telemetry.set_gauge("search.kills", float(n))
+
+    def note_false_kill(self) -> None:
+        """Hindsight verdict: a killed trial's sibling re-run finished
+        above best-so-far (sweep smoke's false-kill gate)."""
+        with self._lock:
+            self.n_false_kills += 1
+            n = self.n_false_kills
+        telemetry.set_gauge("search.false_kills", float(n))
+
+    def note_speculation(self) -> None:
+        """One in-flight trial fed the advisor a predicted score. The
+        propose meter stays open — the trial is still running."""
+        with self._lock:
+            self.n_speculations += 1
+            n = self.n_speculations
+        telemetry.set_gauge("search.speculations", float(n))
+
+    def note_correction(self) -> None:
+        """One speculative score replaced by the trial's true score."""
+        with self._lock:
+            self.n_corrections += 1
+            n = self.n_corrections
+        telemetry.set_gauge("search.corrections", float(n))
 
     def note_feedback(self, knobs_hash: str, score: float) -> bool:
         """Close the meter for one proposal. Returns True when the
@@ -130,6 +170,10 @@ class SearchLedger:
             "regret": regret,
             "best_score": (round(self.best_score, 6)
                            if self.best_score is not None else None),
+            "n_killed": self.n_killed,
+            "n_false_kills": self.n_false_kills,
+            "n_speculations": self.n_speculations,
+            "n_corrections": self.n_corrections,
         }
 
     def snapshot(self) -> Dict[str, Any]:

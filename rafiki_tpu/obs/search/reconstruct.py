@@ -56,17 +56,37 @@ def reconstruct(records: List[Dict[str, Any]], job: Optional[str] = None,
     adv = [r for r in records
            if r.get("kind") == "advisor" and _match(r, job)]
     groups: Dict[str, Dict[str, Any]] = {}
+    # The curve plane (predict/kill/false_kill) journals from the
+    # coordinator, which deliberately knows no advisor identity —
+    # these records join the sweep by knobs_hash, not by group.
+    predicts: List[Dict[str, Any]] = []
+    kills: List[Dict[str, Any]] = []
+    false_kills: List[Dict[str, Any]] = []
     for r in adv:
+        if r.get("name") == "predict":
+            predicts.append(r)
+            continue
+        if r.get("name") == "kill":
+            kills.append(r)
+            continue
+        if r.get("name") == "false_kill":
+            false_kills.append(r)
+            continue
         g = groups.setdefault(_group_key(r), {
             "engine": r.get("engine"), "seed": r.get("seed"),
             "job_id": r.get("job_id"),
-            "proposes": [], "feedbacks": [], "batches": []})
+            "proposes": [], "feedbacks": [], "batches": [],
+            "speculates": [], "corrects": []})
         if r.get("name") == "propose":
             g["proposes"].append(r)
         elif r.get("name") == "feedback":
             g["feedbacks"].append(r)
         elif r.get("name") == "propose_batch":
             g["batches"].append(r)
+        elif r.get("name") == "speculate":
+            g["speculates"].append(r)
+        elif r.get("name") == "correct":
+            g["corrects"].append(r)
 
     errors: List[Dict[str, Any]] = []
 
@@ -101,6 +121,32 @@ def reconstruct(records: List[Dict[str, Any]], job: Optional[str] = None,
                         "group": key, "knobs_hash": h, "ts": b.get("ts"),
                         "detail": "a propose_batch member has no matching "
                                   "advisor/propose record"})
+        # Membership (not count) check: rehydration legitimately
+        # re-journals a speculation it replays, so duplicates per hash
+        # are fine — a speculation for a never-proposed assignment is
+        # not.
+        proposed = {p.get("knobs_hash") for p in g["proposes"]}
+        for s in g["speculates"]:
+            if s.get("knobs_hash") not in proposed:
+                errors.append({
+                    "type": "speculate_without_propose", "group": key,
+                    "knobs_hash": s.get("knobs_hash"), "ts": s.get("ts"),
+                    "detail": "a speculative score entered the advisor "
+                              "for a knob assignment no advisor/propose "
+                              "record ever chose"})
+
+    # Kill verdicts join globally (coordinator records carry no group
+    # identity): a kill for a hash nobody proposed escaped the audit
+    # trail.
+    all_proposed = {p.get("knobs_hash")
+                    for g in groups.values() for p in g["proposes"]}
+    for kr in kills:
+        if kr.get("knobs_hash") not in all_proposed:
+            errors.append({
+                "type": "kill_without_propose",
+                "knobs_hash": kr.get("knobs_hash"), "ts": kr.get("ts"),
+                "detail": "an early-kill verdict names a knob assignment "
+                          "no advisor/propose record ever chose"})
 
     # -- pick the main sweep + random baseline -------------------------------
     def _n(gk: str) -> int:
@@ -147,6 +193,15 @@ def reconstruct(records: List[Dict[str, Any]], job: Optional[str] = None,
         fb_q: Dict[str, List[Dict[str, Any]]] = {}
         for f in g["feedbacks"]:
             fb_q.setdefault(f.get("knobs_hash"), []).append(f)
+        # Curve-plane joins, last record per hash wins (the newest fit
+        # has the most observations).
+        predict_by_hash = {p.get("knobs_hash"): p for p in predicts}
+        kill_by_hash = {kr.get("knobs_hash"): kr for kr in kills}
+        false_kill_hashes = {fk.get("knobs_hash") for fk in false_kills}
+        speculated_hashes = {s.get("knobs_hash")
+                             for s in g["speculates"]}
+        correct_by_hash = {c.get("knobs_hash"): c for c in g["corrects"]}
+        pred_errors: List[float] = []
         for seq, p in enumerate(g["proposes"], start=1):
             h = p.get("knobs_hash")
             fb = fb_q.get(h)
@@ -167,6 +222,29 @@ def reconstruct(records: List[Dict[str, Any]], job: Optional[str] = None,
                 "n_epoch_evals": (t or {}).get("n_epoch_evals"),
                 "status": (t or {}).get("status"),
             }
+            pr = predict_by_hash.get(h) or kill_by_hash.get(h)
+            if pr is not None:
+                row["predicted_final"] = pr.get("predicted")
+                row["prediction_band"] = pr.get("band")
+            if h in kill_by_hash:
+                row["killed"] = True
+                row["kill_epoch"] = kill_by_hash[h].get("epoch")
+                row["false_kill"] = h in false_kill_hashes
+            if h in speculated_hashes:
+                row["speculated"] = True
+                row["corrected"] = h in correct_by_hash
+            # Per-trial prediction error: the truth (real score, or a
+            # correction's `actual`) vs the newest mid-flight
+            # prediction.
+            truth = None
+            if f is not None and not doomed:
+                truth = float(f["score"])
+            elif h in correct_by_hash:
+                truth = correct_by_hash[h].get("actual")
+            if truth is not None and row.get("predicted_final") is not None:
+                err = float(truth) - float(row["predicted_final"])
+                row["prediction_error"] = round(err, 9)
+                pred_errors.append(abs(err))
             proposals.append(row)
             if f is not None and not doomed:
                 scores.append(float(f["score"]))
@@ -189,6 +267,40 @@ def reconstruct(records: List[Dict[str, Any]], job: Optional[str] = None,
                 round(len(scores) / (span_s / 3600.0), 4)
                 if span_s > 0 and scores else None),
         })
+        # -- learning-curve roll-up (docs/early_kill.md) ---------------------
+        n_kills = sum(1 for row in proposals if row.get("killed"))
+        n_false = sum(1 for row in proposals if row.get("false_kill"))
+        true_kills = n_kills - n_false
+        # Recall ground truth: scored trials that finished below
+        # final-best minus the kill margin SHOULD have been killed;
+        # each one that ran to completion is a miss. Margin comes from
+        # the kill records' own config (they carry the knobs in force).
+        margin = 0.02
+        for kr in kills:
+            cfg = kr.get("config") or {}
+            if cfg.get("margin") is not None:
+                margin = float(cfg["margin"])
+                break
+        final_best = doc["curve"]["best_score"]
+        missed = (sum(1 for s in scores if s < final_best - margin)
+                  if final_best is not None else 0)
+        curve_stats: Dict[str, Any] = {
+            "n_predicts": len(predicts),
+            "n_kills": n_kills,
+            "n_false_kills": n_false,
+            "n_speculations": len(g["speculates"]),
+            "n_corrections": len(g["corrects"]),
+            "kill_precision": (round(true_kills / n_kills, 4)
+                               if n_kills else None),
+            "kill_recall": (round(true_kills / (true_kills + missed), 4)
+                            if (true_kills + missed) else None),
+            "mean_abs_prediction_error": (
+                round(sum(pred_errors) / len(pred_errors), 6)
+                if pred_errors else None),
+        }
+        doc["curve_advisor"] = curve_stats
+        doc.update({k: v for k, v in curve_stats.items()
+                    if k != "n_predicts"})
 
     # -- advisor lift vs the random baseline ---------------------------------
     if main_key is not None and base_key is not None:
@@ -227,7 +339,10 @@ def artifact(doc: Dict[str, Any]) -> Dict[str, Any]:
     keys = ("sweep_schema_version", "job", "engine", "seed",
             "n_proposals", "n_scored", "n_doomed", "span_s",
             "best_score", "regret", "effective_trials_per_hour",
-            "advisor_lift", "lift_ci_low", "lift_ci_high")
+            "advisor_lift", "lift_ci_low", "lift_ci_high",
+            "n_kills", "n_false_kills", "n_speculations",
+            "n_corrections", "kill_precision", "kill_recall",
+            "mean_abs_prediction_error")
     out = {k: doc.get(k) for k in keys if doc.get(k) is not None}
     out["sweep_schema_version"] = doc.get("sweep_schema_version",
                                           SWEEP_SCHEMA_VERSION)

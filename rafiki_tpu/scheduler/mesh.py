@@ -79,6 +79,14 @@ class _WalAdvisorHandle:
         self._inner.feedback(score, knobs)
         self._wal.commit(txn, "advisor_feedback")
 
+    def speculate(self, score: float, knobs, fit=None) -> None:
+        # Like proposals, speculations need no WAL record: an
+        # uncorrected speculation is reproducible from its
+        # ``advisor/speculate`` audit journal (rehydrate_advisor
+        # replays them), and the correction rides the normal feedback
+        # path above — which IS bracketed (docs/early_kill.md).
+        self._inner.speculate(score, knobs, fit=fit)
+
 
 class ElasticHandle:
     """Runtime grow/shrink surface for a live sweep (docs/autoscale.md).
@@ -473,6 +481,13 @@ class MeshSweepScheduler:
         # supervisor reads row statuses for completion tracking, so
         # scores must be durable when a pack returns.
         knob_config = model_cls.get_knob_config()
+        # ONE curve coordinator for the whole mesh (None when the
+        # RAFIKI_CURVE_* knobs are off): chips share best-so-far, so a
+        # kill on chip 0 raises the bar for chip 3's stragglers, and a
+        # backfill on any chip can speculate every in-flight trial
+        # fleet-wide (docs/early_kill.md).
+        from rafiki_tpu.advisor.speculative import CurveCoordinator
+        curve = CurveCoordinator.from_env()
         runners: List[_ChipRunner] = []
         for i, dev in enumerate(devices):
             service = self.store.create_service(
@@ -491,6 +506,7 @@ class MeshSweepScheduler:
             # inside the worker — hand it the WAL so those claims are
             # intent/commit-bracketed like the up-front ones.
             worker.wal = self._wal
+            worker.curve = curve
             runners.append(_ChipRunner(i, dev, worker, k, errors,
                                        budget_max=budget_max))
 
@@ -595,6 +611,7 @@ class MeshSweepScheduler:
                 stop_event=stop_event, async_persist=False,
             )
             worker.wal = self._wal
+            worker.curve = curve
             r = _ChipRunner(i, dev, worker, k, errors,
                             budget_max=budget_max)
             r.thread.start()

@@ -26,6 +26,7 @@ import traceback
 from typing import Any, Dict, List, Optional, Protocol
 
 from rafiki_tpu import chaos, telemetry
+from rafiki_tpu.advisor.speculative import CurveCoordinator
 from rafiki_tpu.constants import BudgetType, TrainJobStatus, TrialStatus
 from rafiki_tpu.model.base import BaseModel, load_model_class
 from rafiki_tpu.model.knobs import Knobs, knob_config_signature
@@ -64,6 +65,9 @@ class InProcAdvisorHandle:
     def feedback(self, score: float, knobs: Knobs) -> None:
         self._svc.feedback(self._id, score, knobs)
 
+    def speculate(self, score: float, knobs: Knobs, fit=None) -> None:
+        self._svc.speculate(self._id, score, knobs, fit=fit)
+
 
 def _journal_epoch_eval(trial_id: str, entry: Dict[str, Any],
                         wall_s: Optional[float],
@@ -94,6 +98,22 @@ class PackAborted(RuntimeError):
     RUNNING — deliberately NOT marked errored — so the mesh scheduler
     can re-pack them onto surviving chips, where each resumes from its
     newest per-epoch packed checkpoint (docs/mesh_sweep.md)."""
+
+
+class EarlyKilled(RuntimeError):
+    """A serial trial condemned mid-flight by the learning-curve
+    predictor (docs/early_kill.md): raised from the trial's log sink at
+    an epoch boundary, caught by ``run_trial``'s dedicated arm, which
+    marks the trial errored, charges the doomed bucket and routes the
+    predicted score to the advisor as consolation feedback."""
+
+    def __init__(self, fit, epoch: int, best: float):
+        super().__init__(
+            f"early-killed at epoch {epoch}: predicted final "
+            f"{fit.predicted_final:.4f} (hi {fit.hi:.4f}) vs best {best:.4f}")
+        self.fit = fit
+        self.epoch = int(epoch)
+        self.best = float(best)
 
 
 class TrainWorker:
@@ -151,6 +171,13 @@ class TrainWorker:
         if trial_pack is None:
             trial_pack = int(os.environ.get("RAFIKI_TRIAL_PACK", "1"))
         self.trial_pack = max(1, int(trial_pack))
+        # Learning-curve kill/speculation coordinator (docs/
+        # early_kill.md). None unless RAFIKI_CURVE_KILL or
+        # RAFIKI_CURVE_SPECULATE is set — every consult site guards on
+        # `is None`, so the off path is today's loop bit-exactly. The
+        # mesh scheduler overwrites this with one coordinator shared
+        # across its chip workers (cross-chip best-so-far + stragglers).
+        self.curve = CurveCoordinator.from_env()
         from rafiki_tpu.config import get_config
 
         self.heartbeat_min_interval_s = get_config().trial_heartbeat_s
@@ -203,6 +230,17 @@ class TrainWorker:
             _journal_epoch_eval(tid, entry,
                                # lint: disable=RF007 — epoch_eval wall field, already under trial.total
                                wall_s=time.monotonic() - t_trial0)
+            if self.curve is not None and entry.get("type") == "values":
+                values = entry.get("values") or {}
+                # Higher-is-better curves only (acc); loss-only models
+                # are never killed — the conservative default.
+                if "epoch" in values and values.get("acc") is not None:
+                    ep = int(values["epoch"])
+                    self.curve.observe(knobs, ep, float(values["acc"]),
+                                       trial_id=tid)
+                    fit = self.curve.kill_verdict(knobs, ep, trial_id=tid)
+                    if fit is not None:
+                        raise EarlyKilled(fit, ep, self.curve.best_so_far)
             if self.service_id is not None:
                 # Epoch logs double as liveness: long trials heartbeat
                 # from inside, so failure detection doesn't flag them.
@@ -252,6 +290,8 @@ class TrainWorker:
             # serialize), so overlapping it nearly doubles short-trial
             # throughput.
             self.advisor.feedback(score, knobs)
+            if self.curve is not None:
+                self.curve.note_scored(knobs, score)
             telemetry.inc("worker.trials_succeeded")
             if self._saver is not None:
                 self._saver.submit(tid, model, score, sink)
@@ -259,6 +299,31 @@ class TrainWorker:
             else:
                 with logger.capture(sink):
                     self._persist(tid, model, score)
+            return self.store.get_trial(tid)
+        except EarlyKilled as e:
+            # Learning-curve kill (docs/early_kill.md): same shape as
+            # the divergence arm — fail the trial FAST with a diagnosis,
+            # charge the doomed bucket, keep the worker loop alive. The
+            # consolation feedback carries the conservative PREDICTED
+            # score (it can never beat best-so-far — the kill rule
+            # required hi < best - margin), which steers the advisor
+            # more honestly than a 0.0 floor and replays identically
+            # from the audit journal on rehydration.
+            fit = e.fit
+            telemetry.inc("worker.trials_killed")
+            self.store.mark_trial_as_errored(
+                tid, f"early_killed: predicted {fit.predicted_final:.4f} "
+                     f"(hi {fit.hi:.4f}) vs best {e.best:.4f} "
+                     f"at epoch {e.epoch}")
+            events.emit("trial_killed", trial_id=tid,
+                        worker_id=self.worker_id, epoch=e.epoch,
+                        predicted=fit.predicted_final)
+            self.curve.note_done(knobs)
+            search_audit.note_doomed(knobs)
+            try:
+                self.advisor.feedback(fit.predicted_final, knobs)
+            except Exception:
+                pass
             return self.store.get_trial(tid)
         except _health.DivergenceError as e:
             # Numerics containment (docs/health.md): the train loop
@@ -277,6 +342,8 @@ class TrainWorker:
                         capsule=v.get("capsule"),
                         diagnosis=v.get("diagnosis"))
             _health.note_contained()
+            if self.curve is not None:
+                self.curve.note_done(knobs)
             # Doomed BEFORE the consolation feedback: the search ledger
             # charges this trial's wall to doomed_s, not scored_s.
             search_audit.note_doomed(knobs)
@@ -293,6 +360,8 @@ class TrainWorker:
                         error=err.splitlines()[-1] if err else "")
             # Feed the advisor a floor score so it learns to avoid the
             # region instead of re-proposing it (reference just skips).
+            if self.curve is not None:
+                self.curve.note_done(knobs)
             search_audit.note_doomed(knobs)
             try:
                 self.advisor.feedback(0.0, knobs)
@@ -637,6 +706,9 @@ class PackedTrialRunner:
                         model=w.model_class.__name__, worker_id=w.worker_id,
                         knobs=kn)
         models: List[BaseModel] = []
+        # model_index -> condemning CurveFit; filled by kill_pred below,
+        # read by on_evict (bookkeeping) and the post-train loop (skip).
+        killed: Dict[int, Any] = {}
         pack_entity = f"pack:{w.worker_id}:k{k}"
         try:
             # One pack = one trace + one ledger entity: the pack's
@@ -674,6 +746,49 @@ class PackedTrialRunner:
                     events.emit("pack_member_evicted", trial_id=rows[mi][0],
                                 epoch=epoch, reason=reason,
                                 worker_id=w.worker_id)
+                    if reason != "killed":
+                        return
+                    # Early-kill bookkeeping runs HERE — before the
+                    # backfill closure proposes into the freed slot —
+                    # so the replacement proposal is steered by this
+                    # trial's consolation feedback (the conservative
+                    # predicted score; same contract as the serial
+                    # EarlyKilled arm, docs/early_kill.md).
+                    tid_k, kn_k = rows[mi]
+                    fit = killed.get(mi)
+                    pred = fit.predicted_final if fit is not None else 0.0
+                    telemetry.inc("worker.trials_killed")
+                    w.store.mark_trial_as_errored(
+                        tid_k, f"early_killed: predicted {pred:.4f} "
+                               f"at epoch {epoch}")
+                    events.emit("trial_killed", trial_id=tid_k,
+                                worker_id=w.worker_id, epoch=epoch,
+                                predicted=pred)
+                    w.curve.note_done(kn_k)
+                    search_audit.note_doomed(kn_k)
+                    try:
+                        w.advisor.feedback(pred, kn_k)
+                    except Exception:
+                        pass
+
+                kill_pred = None
+                if w.curve is not None:
+                    def kill_pred(mi: int, epoch: int, metrics) -> bool:
+                        # Feed the live packed curve point, then ask.
+                        # Same higher-is-better guard as the serial
+                        # sink: loss-only packs are never killed.
+                        tid_k, kn_k = rows[mi]
+                        acc = (metrics or {}).get("acc")
+                        if acc is None:
+                            return False
+                        w.curve.observe(kn_k, epoch, float(acc),
+                                        trial_id=tid_k)
+                        fit = w.curve.kill_verdict(kn_k, epoch,
+                                                   trial_id=tid_k)
+                        if fit is None:
+                            return False
+                        killed[mi] = fit
+                        return True
 
                 def backfill(n: int) -> List[BaseModel]:
                     """Fill freed pack slots with freshly proposed
@@ -684,6 +799,13 @@ class PackedTrialRunner:
                     nonlocal drained
                     if drained or w.advisor is None:
                         return []
+                    # Speculative scoring (docs/early_kill.md): feed
+                    # the advisor predicted scores for pack-mates still
+                    # mid-flight so this proposal doesn't draft blind
+                    # next to the constant-liar floor. No-op unless
+                    # RAFIKI_CURVE_SPECULATE is set.
+                    if w.curve is not None:
+                        w.curve.speculate_inflight(w.advisor)
                     pack_key = repr(models[0].packing_key(
                         models[0]._prepared_dataset(w.train_uri)))
                     out: List[BaseModel] = []
@@ -744,7 +866,8 @@ class PackedTrialRunner:
                     histories = w.model_class.train_packed(
                         models, w.train_uri, on_epoch=heartbeat,
                         checkpoint_sink=ckpt_sink,
-                        backfill=backfill, on_evict=on_evict)
+                        backfill=backfill, on_evict=on_evict,
+                        kill_predicate=kill_pred)
                 # Numerics containment (docs/health.md): members the
                 # pack evicted for divergence carry a verdict and hold
                 # their params as-of the bad epoch — they must not
@@ -752,8 +875,10 @@ class PackedTrialRunner:
                 # advisor's scale). Survivors evaluate as usual.
                 verdicts = [getattr(m, "_health_verdict", None)
                             for m in models]
+                # Killed members skip evaluation too — scoring them
+                # would spend exactly the wall the kill saved.
                 healthy_idx = [i for i, v in enumerate(verdicts)
-                               if v is None]
+                               if v is None and i not in killed]
                 with telemetry.span("trial_pack.evaluate"):
                     healthy_scores = (w.model_class.evaluate_packed(
                         [models[i] for i in healthy_idx], w.val_uri)
@@ -773,7 +898,10 @@ class PackedTrialRunner:
             raise
         except Exception:
             err = traceback.format_exc()
-            for tid, kn in rows:
+            for i, (tid, kn) in enumerate(rows):
+                if i in killed:
+                    # Already marked errored + fed back in on_evict.
+                    continue
                 telemetry.inc("worker.trials_errored")
                 w.store.mark_trial_as_errored(tid, err)
                 events.emit("trial_errored", trial_id=tid, worker_id=w.worker_id,
@@ -814,6 +942,17 @@ class PackedTrialRunner:
                         wall_s=(round_walls[pos]
                                 if pos < len(round_walls) else None),
                         packed=True)
+            if i in killed:
+                # Store row, doomed charge and consolation feedback all
+                # happened in on_evict (pre-backfill); the epoch_eval
+                # journal replay above still ran — the curve prefix is
+                # exactly what `obs curves --predicted` audits a kill
+                # against.
+                try:
+                    models[i].destroy()
+                except Exception:
+                    pass
+                continue
             if verdicts[i] is not None:
                 # Same contract as the serial DivergenceError arm:
                 # ERRORED with the diagnosis, floor score to the
@@ -831,6 +970,8 @@ class PackedTrialRunner:
                             capsule=v.get("capsule"),
                             diagnosis=v.get("diagnosis"))
                 _health.note_contained()
+                if w.curve is not None:
+                    w.curve.note_done(kn)
                 search_audit.note_doomed(kn)
                 try:
                     w.advisor.feedback(0.0, kn)
@@ -843,6 +984,8 @@ class PackedTrialRunner:
                 continue
             score = float(scores[i])
             w.advisor.feedback(score, kn)
+            if w.curve is not None:
+                w.curve.note_scored(kn, score)
             telemetry.inc("worker.trials_succeeded")
             telemetry.inc("worker.packed_trials")
             if w._saver is not None:

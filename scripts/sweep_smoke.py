@@ -3,7 +3,7 @@
 journals alone, and a doctored journal must fail reconciliation loudly
 (docs/search_anatomy.md).
 
-Three phases, ~10s total:
+Five phases, ~15s total:
 
   1. **Sweep + reconstruct** — a 12-trial GpAdvisor sweep and a
      12-trial RandomAdvisor baseline over a synthetic quadratic
@@ -17,11 +17,28 @@ Three phases, ~10s total:
      escaped decision on stderr: feedback for a proposal that was
      never journaled means the audit trail leaked, and the sweep plane
      must refuse to pretend otherwise.
-  3. **Report gate, both polarities** — ``bench_report --sweep`` over
+  3. **Early-kill A/B, both polarities** (docs/early_kill.md) — the
+     same seeded proposal stream trained twice over a synthetic
+     epoch-curve objective with real per-epoch sleeps: kill-off runs
+     every trial (doomed ones diverge at the end, charged to the
+     doomed bucket), kill-on condemns them off the curve fit after
+     ``min_obs`` epochs. Both journal dirs reconstruct through the
+     real ``obs sweep`` subprocess; the gate is the ISSUE's claim —
+     kill-on ``effective_trials_per_hour`` >= 1.3x kill-off at a
+     byte-equal final best, zero false kills (each killed trial's
+     sibling re-run to completion stays below best-so-far).
+  4. **Doctored killer** — the same stream under an over-aggressive
+     config (margin=0, warmup=0, min_obs=2) must be CAUGHT: at least
+     one hindsight false kill journaled, kill_precision < 1 in the
+     reconstruction. A killer the false-kill gate cannot catch would
+     let a "faster" sweep quietly discard its best trials.
+  5. **Report gate, both polarities** — ``bench_report --sweep`` over
      synthetic SWEEP_r*.json rounds: an improving trend exits 0, a
      collapsed round (regret up, trials/hour down) exits 1, and a
      reconciliation-failed round reads as no-data, not a
-     zero-regret sweep.
+     zero-regret sweep. The committed repo-root ``SWEEP_r01.json``
+     (regenerate with ``--emit-artifact``) must carry the A/B verdict
+     and pass the same report gate.
 
 Output: one JSON object on stdout. Exit 0 when every assertion holds;
 1 otherwise — this is a CI gate (scripts/check_tier1.sh).
@@ -74,6 +91,218 @@ def _journaled_sweep(log_dir):
                 adv.feedback(_objective(knobs), knobs)
     finally:
         journal.close()
+
+
+# -- early-kill A/B (docs/early_kill.md) -------------------------------------
+#
+# One RandomAdvisor proposal stream (CURVE_SEED) trained twice over a
+# synthetic epoch-curve objective. Half the knob box is doomed: the
+# curve saturates low and the trial diverges on its final epoch —
+# consolation feedback, doomed bucket — in BOTH polarities, so the
+# scored set (and therefore final best) is identical by construction
+# and the only difference the ledger can see is wall: kill-off sinks
+# CURVE_EPOCHS sleeps into every doomed trial, kill-on only min_obs.
+
+N_CURVE_TRIALS = 8
+CURVE_EPOCHS = 10
+EPOCH_S = 0.03
+CURVE_SEED = 10
+EFF_RATIO_FLOOR = 1.3
+KILL_CFG = {"warmup_epochs": 2, "margin": 0.35, "min_obs": 3}
+DOCTORED_KILL_CFG = {"warmup_epochs": 0, "margin": 0.0, "min_obs": 2}
+ROOT_ARTIFACT = os.path.join(REPO, "SWEEP_r01.json")
+
+
+def _curve_profile(knobs):
+    """Deterministic trial destiny from the knob assignment itself —
+    the 'sibling re-run' ground truth is just this function again.
+    Finals are bimodal (doomed plateau 0.10-0.18 vs healthy 0.70-0.90)
+    so a sane margin separates the bands."""
+    from rafiki_tpu.obs.search import audit as search_audit
+
+    h = int(search_audit.knobs_hash(knobs), 16)
+    doomed = (h >> 8) % 2 == 1
+    final = (0.10 + (h % 97) / 97.0 * 0.08) if doomed \
+        else (0.70 + (h % 89) / 89.0 * 0.20)
+    return round(final, 6), doomed, h
+
+
+def _epoch_score(h_int, final, e):
+    """Saturating curve with a deterministic per-trial wiggle — enough
+    noise that a 2-observation fit can be badly wrong (the doctored
+    killer's trap) while a min_obs=3 fit still lands inside the band."""
+    wiggle = 1.0 + 0.06 * math.sin((h_int % 7) + 1.7 * e)
+    return round(final * (1.0 - math.exp(-(e + 1) / 2.0)) * wiggle, 6)
+
+
+def _curved_sweep(log_dir, kill_cfg):
+    """Run the seeded stream once; ``kill_cfg=None`` is the kill-off
+    polarity (no coordinator at all — the off path must not even
+    consult the extrapolator). Returns run counters."""
+    import time
+
+    from rafiki_tpu.advisor.curve import KillConfig
+    from rafiki_tpu.advisor.random_advisor import RandomAdvisor
+    from rafiki_tpu.advisor.speculative import CurveCoordinator
+    from rafiki_tpu.model.knobs import FixedKnob, FloatKnob, IntegerKnob
+    from rafiki_tpu.obs.journal import journal
+    from rafiki_tpu.obs.search import audit as search_audit
+    from rafiki_tpu.obs.search.ledger import search_ledger
+
+    kc = {"lr": FloatKnob(1e-4, 3e-2, is_exp=True),
+          "units": IntegerKnob(4, 64),
+          "b": FixedKnob(8)}
+    search_ledger.reset()
+    journal.configure(log_dir, role="sweep")
+    counts = {"killed": 0, "diverged": 0, "scored": 0, "false_kills": 0}
+    killed = []  # (knobs, predicted_final, best_at_kill)
+    try:
+        adv = RandomAdvisor(kc, seed=CURVE_SEED)
+        coord = (CurveCoordinator(KillConfig(enabled=True, **kill_cfg))
+                 if kill_cfg else None)
+        for t in range(N_CURVE_TRIALS):
+            knobs = adv.propose()
+            final, doomed, h_int = _curve_profile(knobs)
+            was_killed = False
+            score = 0.0
+            for e in range(CURVE_EPOCHS):
+                time.sleep(EPOCH_S)
+                score = _epoch_score(h_int, final, e)
+                if coord is None:
+                    continue
+                coord.observe(knobs, e, score, trial_id=f"t{t:02d}",
+                              horizon=CURVE_EPOCHS)
+                fit = coord.kill_verdict(knobs, e, trial_id=f"t{t:02d}")
+                if fit is not None:
+                    killed.append((knobs, fit.predicted_final,
+                                   coord.best_so_far))
+                    search_audit.note_doomed(knobs)
+                    adv.feedback(0.0, knobs)
+                    was_killed = True
+                    break
+            if was_killed:
+                counts["killed"] += 1
+            elif doomed:
+                # The trial diverges at the end — the same consolation
+                # path the workers take, identical in both polarities.
+                search_audit.note_doomed(knobs)
+                adv.feedback(0.0, knobs)
+                if coord is not None:
+                    coord.note_done(knobs)
+                counts["diverged"] += 1
+            else:
+                adv.feedback(score, knobs)
+                if coord is not None:
+                    coord.note_scored(knobs, score)
+                counts["scored"] += 1
+        # Hindsight pass: re-run every killed trial's knobs to
+        # completion (the analytic profile IS the sibling) and journal
+        # a false-kill verdict when the sibling beats best-so-far.
+        for knobs, predicted, best_at in killed:
+            sibling, _, h_int = _curve_profile(knobs)
+            sibling_score = _epoch_score(h_int, sibling, CURVE_EPOCHS - 1)
+            if best_at is not None and sibling_score > best_at:
+                search_audit.record_false_kill(
+                    knobs, killed_predicted=predicted,
+                    sibling_score=sibling_score, best_so_far=best_at)
+                counts["false_kills"] += 1
+    finally:
+        journal.close()
+    return counts
+
+
+def _reconstruct_artifact(log_dir, name):
+    """The real `obs sweep` subprocess over one polarity's journals."""
+    out = os.path.join(log_dir, name)
+    r = _run([sys.executable, "-m", "rafiki_tpu.obs", "--dir", log_dir,
+              "--json", "sweep", "--out", out])
+    art = json.load(open(out)) if os.path.exists(out) else {}
+    return r, art
+
+
+def _root_artifact_doc(art_on, art_off):
+    """The committed SWEEP_r01.json: the kill-on artifact with the
+    kill-off polarity side by side and the A/B verdict explicit."""
+    eff_on = art_on.get("effective_trials_per_hour") or 0.0
+    eff_off = art_off.get("effective_trials_per_hour") or 0.0
+    doc = dict(art_on)
+    doc["kill_off"] = {k: art_off.get(k) for k in (
+        "effective_trials_per_hour", "span_s", "n_scored", "n_doomed",
+        "best_score", "regret")}
+    doc["kill_on_vs_off"] = {
+        "eff_ratio": round(eff_on / eff_off, 4) if eff_off else None,
+        "best_delta": round((art_on.get("best_score") or 0.0)
+                            - (art_off.get("best_score") or 0.0), 9),
+        "eff_ratio_floor": EFF_RATIO_FLOOR,
+    }
+    return doc
+
+
+def phase_early_kill(results):
+    on_dir = tempfile.mkdtemp(prefix="sweep_smoke_killon_")
+    off_dir = tempfile.mkdtemp(prefix="sweep_smoke_killoff_")
+    c_off = _curved_sweep(off_dir, None)
+    c_on = _curved_sweep(on_dir, KILL_CFG)
+    r_off, art_off = _reconstruct_artifact(off_dir, "SWEEP_off.json")
+    r_on, art_on = _reconstruct_artifact(on_dir, "SWEEP_on.json")
+    eff_on = art_on.get("effective_trials_per_hour")
+    eff_off = art_off.get("effective_trials_per_hour")
+    ph = {
+        "counts_on": c_on,
+        "counts_off": c_off,
+        "rc": [r_off.returncode, r_on.returncode],
+        "eff_on": eff_on,
+        "eff_off": eff_off,
+        "eff_ratio": (round(eff_on / eff_off, 4)
+                      if eff_on and eff_off else None),
+        "best_on": art_on.get("best_score"),
+        "best_off": art_off.get("best_score"),
+        "n_kills": art_on.get("n_kills"),
+        "n_false_kills": art_on.get("n_false_kills"),
+        "kill_precision": art_on.get("kill_precision"),
+        "ok": False,
+    }
+    ph["ok"] = (
+        r_off.returncode == 0 and r_on.returncode == 0
+        and c_on["false_kills"] == 0
+        and c_on["killed"] >= 2
+        and c_on["scored"] == c_off["scored"] >= 3
+        and ph["eff_ratio"] is not None
+        and ph["eff_ratio"] >= EFF_RATIO_FLOOR
+        and ph["best_on"] is not None
+        and ph["best_on"] == ph["best_off"]
+        and art_on.get("n_kills") == c_on["killed"]
+        and art_on.get("n_false_kills") == 0
+        and art_on.get("kill_precision") == 1.0
+        and (art_off.get("n_kills") or 0) == 0)
+    if not ph["ok"]:
+        ph["stderr"] = (r_on.stderr or r_off.stderr)[-400:]
+    results["early_kill"] = ph
+    return (art_on, art_off) if ph["ok"] else None
+
+
+def phase_doctored_killer(results):
+    """An over-aggressive config must be CAUGHT by the false-kill
+    gate, not rewarded for its trials/hour."""
+    d_dir = tempfile.mkdtemp(prefix="sweep_smoke_killdoc_")
+    c = _curved_sweep(d_dir, DOCTORED_KILL_CFG)
+    r, art = _reconstruct_artifact(d_dir, "SWEEP_doctored.json")
+    ph = {
+        "counts": c,
+        "rc": r.returncode,
+        "n_kills": art.get("n_kills"),
+        "n_false_kills": art.get("n_false_kills"),
+        "kill_precision": art.get("kill_precision"),
+        "ok": False,
+    }
+    ph["ok"] = (r.returncode == 0
+                and c["false_kills"] >= 1
+                and art.get("n_false_kills") == c["false_kills"]
+                and (art.get("kill_precision") or 1.0) < 1.0)
+    if not ph["ok"]:
+        ph["stderr"] = r.stderr[-400:]
+    results["doctored_killer"] = ph
+    return ph["ok"]
 
 
 def phase_reconstruct(results):
@@ -210,12 +439,74 @@ def phase_report_gate(results, log_dir):
     return ph["ok"]
 
 
+def phase_root_artifact(results):
+    """The committed repo-root SWEEP_r01.json must be the real thing:
+    carries the A/B verdict above the floor, zero false kills, and
+    passes the same ``bench_report --sweep`` gate CI trends."""
+    try:
+        doc = json.load(open(ROOT_ARTIFACT))
+    except (OSError, ValueError):
+        doc = {}
+    verdict = doc.get("kill_on_vs_off") or {}
+    r = _run([sys.executable, "scripts/bench_report.py", "--sweep",
+              ROOT_ARTIFACT])
+    try:
+        rep = json.loads(r.stdout)
+    except ValueError:
+        rep = {}
+    has_data = any(x.get("has_data") for x in rep.get("rounds", []))
+    ph = {
+        "exists": os.path.exists(ROOT_ARTIFACT),
+        "eff_ratio": verdict.get("eff_ratio"),
+        "best_delta": verdict.get("best_delta"),
+        "n_kills": doc.get("n_kills"),
+        "report_rc": r.returncode,
+        "report_has_data": has_data,
+        "ok": False,
+    }
+    ph["ok"] = (ph["exists"]
+                and (verdict.get("eff_ratio") or 0.0) >= EFF_RATIO_FLOOR
+                and verdict.get("best_delta") == 0.0
+                and (doc.get("n_kills") or 0) >= 1
+                and doc.get("n_false_kills") == 0
+                and r.returncode == 0 and has_data)
+    if not ph["ok"]:
+        ph["stderr"] = r.stderr[-300:]
+    results["root_artifact"] = ph
+    return ph["ok"]
+
+
+def emit_artifact() -> int:
+    """Regenerate the committed repo-root SWEEP_r01.json from a fresh
+    A/B run (``sweep_smoke.py --emit-artifact``)."""
+    results = {}
+    ab = phase_early_kill(results)
+    if ab is None:
+        print(json.dumps(results, indent=2))
+        return 1
+    doc = _root_artifact_doc(*ab)
+    with open(ROOT_ARTIFACT, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"written": ROOT_ARTIFACT,
+                      "kill_on_vs_off": doc["kill_on_vs_off"]}))
+    return 0
+
+
 def main() -> int:
+    if "--emit-artifact" in sys.argv[1:]:
+        return emit_artifact()
     results = {}
     log_dir = phase_reconstruct(results)
     ok = log_dir is not None
     if ok:
         ok = phase_doctored(results, log_dir) and ok
+    if ok:
+        ok = phase_early_kill(results) is not None and ok
+    if ok:
+        ok = phase_doctored_killer(results) and ok
+    if ok:
+        ok = phase_root_artifact(results) and ok
     if ok:
         ok = phase_report_gate(results, log_dir) and ok
     results["ok"] = ok

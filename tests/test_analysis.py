@@ -31,7 +31,7 @@ def test_all_builtin_checkers_registered():
     assert {"RF001", "RF002", "RF003", "RF004", "RF005", "RF006",
             "RF007", "RF008", "RF009", "RF010", "RF011",
             "RF012", "RF013", "RF014", "RF015", "RF016",
-            "RF017"} <= set(REGISTRY)
+            "RF017", "RF018"} <= set(REGISTRY)
 
 
 # ---------------------------------------------------------------------------
@@ -1305,4 +1305,106 @@ def test_rf017_current_tree_is_clean():
                        os.path.join(REPO, "bench.py"),
                        os.path.join(REPO, "scripts")], select=["RF017"])
     mine = [f for f in r.unsuppressed if f.checker_id == "RF017"]
+    assert mine == [], [f"{f.path}:{f.line}" for f in mine]
+
+
+# ---------------------------------------------------------------------------
+# RF018 unaudited-speculation
+# ---------------------------------------------------------------------------
+
+
+RF018_BAD_MUTATION = """
+    class LeakyAdvisor:
+        def adopt_rows(self, rows):
+            for x, y in rows:
+                self._X.append(x)
+                self._y.append(y)
+
+        def drop_worst(self):
+            del self._y[0]
+    """
+
+
+def test_rf018_fires_on_training_data_mutation_outside_surfaces(tmp_path):
+    r = _advisor_snippet(tmp_path, RF018_BAD_MUTATION, select=["RF018"])
+    found = [f for f in r.unsuppressed if f.checker_id == "RF018"]
+    # append(x), append(y), del — three mutation sites
+    assert len(found) == 3
+    assert all(f.severity == "error" for f in found)
+    assert "byte-identity" in found[0].message
+
+
+def test_rf018_fires_on_unaudited_kill_site(tmp_path):
+    r = _advisor_snippet(tmp_path, """
+        class SilentKiller:
+            def kill_verdict(self, h, epoch):
+                st = self.trials[h]
+                st.killed = True
+                return st.fit
+        """, select=["RF018"])
+    found = [f for f in r.unsuppressed if f.checker_id == "RF018"]
+    assert len(found) == 1
+    assert "record_kill" in found[0].message
+
+
+def test_rf018_scoped_to_advisor_package_only(tmp_path):
+    # The identical source OUTSIDE rafiki_tpu/advisor/ is legal: the
+    # contract binds the advisor package, not arbitrary code.
+    r = _analyze_snippet(tmp_path, RF018_BAD_MUTATION, select=["RF018"])
+    assert "RF018" not in _ids(r)
+
+
+def test_rf018_quiet_on_sanctioned_surfaces_and_audited_kills(tmp_path):
+    r = _advisor_snippet(tmp_path, """
+        from rafiki_tpu.obs.search import audit
+
+        class GoodAdvisor:
+            def _feedback(self, score, knobs):
+                self._X.append(knobs)
+                self._y.append(score)
+                audit.record_feedback(self, score, knobs)
+
+            def _speculate(self, score, knobs):
+                self._X.append(knobs)
+                self._y.append(score)
+
+            def _correct(self, score, knobs, predicted):
+                self._y[0] = score
+                audit.record_correct(self, knobs, predicted, score)
+
+            def kill_verdict(self, h, epoch, best):
+                st = self.trials[h]
+                st.killed = True
+                audit.record_kill(st.knobs, st.fit, epoch, best,
+                                  config={}, trial_id=None)
+                return st.fit
+        """, select=["RF018"])
+    assert "RF018" not in _ids(r)
+
+
+def test_rf018_pure_kill_predicate_is_not_a_decision_site(tmp_path):
+    # KillConfig.should_kill's shape: comparisons only, no state
+    # mutated — a predicate, not a decision; the caller journals.
+    r = _advisor_snippet(tmp_path, """
+        class KillConfig:
+            def should_kill(self, fit, epoch, best):
+                return fit.hi < best - self.margin
+        """, select=["RF018"])
+    assert "RF018" not in _ids(r)
+
+
+def test_rf018_justified_suppression_honored(tmp_path):
+    r = _advisor_snippet(tmp_path, """
+        class RebuildShim:
+            def rebuild(self, rows):
+                for x, y in rows:
+                    # lint: disable=RF018 — rows come FROM advisor/feedback records, already journaled
+                    self._X.append(x)
+        """, select=["RF018"])
+    assert "RF018" not in _ids(r)
+
+
+def test_rf018_current_tree_is_clean():
+    r = analyze_paths([os.path.join(REPO, "rafiki_tpu")], select=["RF018"])
+    mine = [f for f in r.unsuppressed if f.checker_id == "RF018"]
     assert mine == [], [f"{f.path}:{f.line}" for f in mine]

@@ -328,6 +328,10 @@ _SNAPSHOT = {
         "effective_trials_per_hour": 540.0,
         "regret": 0.0834,
         "best_score": 0.91,
+        "n_killed": 2,
+        "n_false_kills": 0,
+        "n_speculations": 3,
+        "n_corrections": 2,
     },
 }
 
