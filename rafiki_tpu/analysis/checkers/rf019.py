@@ -1,0 +1,155 @@
+"""RF019 full-gather-hazard.
+
+Sharded-lane finding (docs/sharding.md): a group-sharded train state
+is the ONE pytree in the system deliberately too big for one host —
+that is why the trial got a chip group in the first place. Any code
+that materializes it whole (``jax.device_get``, ``np.asarray`` /
+``np.array`` on the state or a loop bound to one) silently re-creates
+the exact OOM the lane exists to avoid: it works in the CPU tests,
+where the virtual chips share host RAM, and falls over on a real
+topology at the worst width.
+
+The sanctioned paths both live in ``rafiki_tpu/shard/checkpoint.py``:
+
+* ``save_sharded`` — each shard writes only its local chunk bytes
+  (``addressable_shards``), never the whole tree;
+* ``gather_state`` — the one audited full gather, leaf-at-a-time, for
+  the trial-completion hand-off into a serial loop.
+
+Flagged, in any module except ``rafiki_tpu.shard.checkpoint`` itself:
+a call to ``jax.device_get`` or ``numpy.asarray``/``numpy.array``
+(under any import alias) whose argument is — or contains — group
+state: a name bound to ``ShardedTrainLoop(...)`` or ``train_sharded
+(...)``, or the ``.state`` attribute of one, or a name bound to that
+attribute. Legitimate exceptions (a debug harness that truncates the
+state first) justify-suppress, stating why the copy is bounded.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from rafiki_tpu.analysis.core import Checker, Finding, ModuleContext, register
+from rafiki_tpu.analysis.checkers._ast_util import dotted_name
+
+#: The one module allowed to flatten group state onto a host.
+SANCTIONED_MODULE = "rafiki_tpu.shard.checkpoint"
+
+#: Calls whose result carries group-sharded state.
+STATE_SOURCES = frozenset({"ShardedTrainLoop", "train_sharded"})
+
+#: (module prefix, function names) pairs that materialize an array on
+#: the host.
+_JAX_GATHERS = frozenset({"device_get"})
+_NP_GATHERS = frozenset({"asarray", "array"})
+
+
+def _hazard_names(tree: ast.Module) -> Set[str]:
+    """Dotted call names that gather to host, under this module's
+    import aliases — ``jax.device_get``, ``np.asarray``, a bare
+    ``device_get`` imported from jax, ..."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                alias = a.asname or a.name
+                if a.name == "jax":
+                    names.update(f"{alias}.{g}" for g in _JAX_GATHERS)
+                elif a.name in ("numpy", "jax.numpy"):
+                    names.update(f"{alias}.{g}" for g in _NP_GATHERS)
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                alias = a.asname or a.name
+                if mod == "jax" and a.name in _JAX_GATHERS:
+                    names.add(alias)
+                elif mod in ("numpy", "jax.numpy") and (
+                        a.name in _NP_GATHERS):
+                    names.add(alias)
+    return names
+
+
+def _source_call(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    name = dotted_name(value.func)
+    return bool(name) and name.split(".")[-1] in STATE_SOURCES
+
+
+def _tainted_names(tree: ast.Module) -> Set[str]:
+    """Names bound to group state: loop handles from the source calls
+    (first element of a ``loop, history = train_sharded(...)``
+    unpack), plus names bound to a handle's ``.state``. Two passes in
+    line order reach the ``st = loop.state`` one-hop chains a lint
+    needs; deeper aliasing is out of scope."""
+    tainted: Set[str] = set()
+    assigns = [n for n in ast.walk(tree) if isinstance(n, ast.Assign)]
+    for _ in range(2):
+        for node in assigns:
+            for t in node.targets:
+                if _source_call(node.value):
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+                    elif (isinstance(t, ast.Tuple) and t.elts
+                          and isinstance(t.elts[0], ast.Name)):
+                        tainted.add(t.elts[0].id)
+                elif (isinstance(t, ast.Name)
+                      and _is_state_expr(node.value, tainted)):
+                    tainted.add(t.id)
+    return tainted
+
+
+def _is_state_expr(node: ast.AST, tainted: Set[str]) -> bool:
+    """``loop`` / ``loop.state`` / ``st`` for tainted bindings."""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute) and node.attr == "state":
+        return (isinstance(node.value, ast.Name)
+                and node.value.id in tainted)
+    return False
+
+
+@register
+class FullGatherHazard(Checker):
+    id = "RF019"
+    name = "full-gather-hazard"
+    severity = "error"
+    rationale = ("device_get/np.asarray of a group-sharded train "
+                 "state materializes on one host the exact tree the "
+                 "sharded lane exists to split — route it through "
+                 "rafiki_tpu.shard.checkpoint (save_sharded chunk "
+                 "manifests, or gather_state for the completion "
+                 "hand-off), or justify-suppress stating why the "
+                 "copy is bounded")
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if ctx.module_name == SANCTIONED_MODULE:
+            return []
+        hazards = _hazard_names(ctx.tree)
+        if not hazards:
+            return []
+        tainted = _tainted_names(ctx.tree)
+        if not tainted:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name or name not in hazards:
+                continue
+            for arg in node.args:
+                if any(_is_state_expr(sub, tainted)
+                       for sub in ast.walk(arg)):
+                    findings.append(self.finding(
+                        ctx, node,
+                        f"`{name}` gathers a group-sharded train "
+                        f"state whole onto one host — the tree a "
+                        f"sharded trial holds is sized for the GROUP, "
+                        f"not a chip; use save_sharded's per-shard "
+                        f"chunk manifests, or gather_state "
+                        f"(rafiki_tpu.shard.checkpoint) for the "
+                        f"completion hand-off"))
+                    break
+        return findings

@@ -145,6 +145,12 @@ KNOB_DOCS = {
         "(docs/recovery.md)",
     "RAFIKI_RESUME_STALE_S": "supervisor heartbeat age before a job is "
         "adoptable by resume (docs/recovery.md)",
+    "RAFIKI_SHARD_HBM_CEILING": "per-chip HBM fraction the shard "
+        "planner fits a group member under (docs/sharding.md)",
+    "RAFIKI_SHARD_MAX_WIDTH": "cap on the solved group width even "
+        "when the HBM estimate wants more chips",
+    "RAFIKI_SHARD_WIDTH": "pin the group width (tests/smokes); 0 "
+        "solves it from the HBM estimate",
     "RAFIKI_SLO": "SLO spec overrides as JSON; empty keeps the "
         "defaults (docs/slo.md)",
     "RAFIKI_SLO_TICK_S": "SLO burn-rate evaluation cadence",

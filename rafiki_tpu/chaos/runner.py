@@ -37,7 +37,8 @@ from rafiki_tpu.obs.journal import journal
 _RECOVERY_SCENARIOS = frozenset({
     "kill-mid-trial-resume", "kill-mid-pack-resume",
     "checkpoint-write-failure", "drain-under-load",
-    "mesh-chip-loss-repack", "collective-kill-mid-step",
+    "mesh-chip-loss-repack", "chip-loss-mid-sharded-trial",
+    "collective-kill-mid-step",
     "mesh-degrades-single-chip", "load-spike-scale-up",
     "supervisor-kill-mid-sweep", "host-loss-mid-sweep",
 })

@@ -41,6 +41,12 @@ mesh-chip-loss-repack          a chip preempted mid-sweep: the mesh
                                the survivor, every trial completes with a
                                score, and resumed params bit-match
                                unfaulted serial runs
+chip-loss-mid-sharded-trial    member 1 of a width-2 sharded group
+                               preempted mid-trial: the group aborts at
+                               the epoch boundary with that epoch's
+                               manifest durable, re-forms at width 1,
+                               resumes via reshard-on-restore, and the
+                               final params bit-match an unfaulted run
 pack-straggler-evict           one pack member early-stops epochs before
                                its mates: it is evicted from the stacked
                                state mid-pack, its slot backfilled with a
@@ -195,7 +201,8 @@ def _check_rows(check, store, job_id, expect: int):
     return trials
 
 
-def _params_match_serial(check, params, trials, source=None, cls_name=None):
+def _params_match_serial(check, params, trials, source=None, cls_name=None,
+                         train_uri=None):
     """Bit-match invariant: each resumed trial's persisted params equal
     a fresh unfaulted serial train() with the same knobs (seed knob
     defaults identically), leaf for leaf."""
@@ -205,6 +212,7 @@ def _params_match_serial(check, params, trials, source=None, cls_name=None):
     from rafiki_tpu.utils.serial import load_pytree
 
     cls = load_model_class(source or FF_SOURCE, cls_name or "ChaosFF")
+    train_uri = train_uri or TRAIN
 
     def leaves(blob: bytes):
         import pickle
@@ -221,7 +229,7 @@ def _params_match_serial(check, params, trials, source=None, cls_name=None):
 
     for t in trials:
         ref = cls(**t["knobs"])
-        ref.train(TRAIN)
+        ref.train(train_uri)
         got = dict(flat(leaves(params.load(t["params_id"]))))
         want = dict(flat(leaves(ref.dump_parameters())))
         ref.destroy()
@@ -611,6 +619,98 @@ def mesh_chip_loss_repack(tmp, check: CheckFn) -> None:
     ent = ledger.snapshot()["entities"].get(f"mesh:{job['id']}", {})
     check("downtime_charged", ent.get("downtime_s", 0.0) > 0.0, ent)
     _params_match_serial(check, params, trials)
+
+
+# A Transformer family with every shape knob fixed: one knob config, so
+# a fresh model with a trial's knobs retrains bit-identically — and the
+# width-invariance of the sharded loop (shard/loop.py) makes that same
+# serial run the reference for a GROUP trial at any width.
+SHARD_SOURCE = b"""
+from rafiki_tpu.model.knobs import FixedKnob, FloatKnob
+from rafiki_tpu.models.transformer import Transformer
+
+class ShardTf(Transformer):
+    @staticmethod
+    def get_knob_config():
+        return {
+            "embed_dim": FixedKnob(32),
+            "num_heads": FixedKnob(2),
+            "num_layers": FixedKnob(1),
+            "learning_rate": FloatKnob(1e-3, 1e-2, is_exp=True),
+            "batch_size": FixedKnob(32),
+            "epochs": FixedKnob(3),
+            "seed": FixedKnob(0),
+        }
+"""
+
+SHARD_TRAIN = "synthetic://text?vocab=81&classes=5&n=256&len=16&seed=0"
+SHARD_VAL = "synthetic://text?vocab=81&classes=5&n=64&len=16&seed=1"
+
+
+@scenario(
+    "chip-loss-mid-sharded-trial",
+    "Preempt member 1 of a width-2 sharded group mid-trial: the group "
+    "must abort at the epoch boundary (that epoch's shard-chunk "
+    "manifest durable FIRST), re-form at width 1 on the survivor, "
+    "resume via reshard-on-restore, complete with a score, and the "
+    "final params must bit-match an unfaulted serial run.",
+    spec="seed=11;scheduler.preempt:kill:after=2:times=1:match=chip1",
+    env={"RAFIKI_CHECKPOINT_EVERY": "1", "RAFIKI_SHARD_WIDTH": "2"},
+)
+def chip_loss_mid_sharded_trial(tmp, check: CheckFn) -> None:
+    from rafiki_tpu import chaos, telemetry
+    from rafiki_tpu.obs import journal as journal_mod
+    from rafiki_tpu.scheduler import MeshSweepScheduler
+    from rafiki_tpu.store import MetaStore, ParamsStore
+
+    store = MetaStore(tmp / "meta.sqlite3")
+    params = ParamsStore(tmp / "params")
+    model = store.create_model("shardtf", "TEXT_CLASSIFICATION", None,
+                               SHARD_SOURCE, "ShardTf")
+    job = store.create_train_job("shardapp", "TEXT_CLASSIFICATION", None,
+                                 SHARD_TRAIN, SHARD_VAL,
+                                 {"MODEL_TRIAL_COUNT": 1})
+    store.create_sub_train_job(job["id"], model["id"])
+    sched = MeshSweepScheduler(store, params)
+    result = sched.run_sweep(job["id"], chips=2, trials_per_chip=1,
+                             advisor_kind="random")
+    check("job_completed", result.status == "COMPLETED", result.errors)
+    trials = _check_rows(check, store, job["id"], expect=1)
+    check("score_recorded", trials[0].get("score") is not None, trials[0])
+    check("group_worker_finished",
+          (trials[0].get("worker_id") or "").endswith("-shard-g0"),
+          f"worker id: {trials[0].get('worker_id')}")
+    # The kill really fired, against a group member specifically —
+    # reject a vacuous pass where the fault never landed.
+    plane = chaos.active()
+    fired = [] if plane is None else plane.schedule()
+    check("preempt_fired",
+          any(site == "scheduler.preempt" and key == "chip1"
+              for site, _mode, _hit, key in fired),
+          f"schedule: {fired}")
+    check("chip_loss_counted",
+          telemetry.get_counter("mesh.chips_lost") >= 1.0,
+          "no mesh.chips_lost increments")
+    # Recovery restored a durable manifest onto the narrower mesh.
+    check("reshard_restore_counted",
+          telemetry.get_counter("shard.reshard_restores") >= 1.0,
+          "no shard.reshard_restores increments")
+    # The width history reconstructs from the journal stream alone:
+    # formed at 2, member lost, re-formed at 1, resharded 2 -> 1.
+    recs = journal_mod.read_dir(journal_mod.journal.log_dir)
+    shard = [r for r in recs if r.get("kind") == "shard"]
+    widths = [r.get("width") for r in shard if r.get("name") == "group_formed"]
+    check("group_formed_then_reformed", widths == [2, 1],
+          f"group_formed widths: {widths}")
+    check("journal_records_member_loss",
+          _journal_has(recs, "shard", "member_lost"),
+          "no shard/member_lost journal record")
+    reshards = [(r.get("from_width"), r.get("to_width"))
+                for r in shard if r.get("name") == "reshard"]
+    check("journal_records_reshard", (2, 1) in reshards,
+          f"reshard records: {reshards}")
+    _params_match_serial(check, params, trials, source=SHARD_SOURCE,
+                         cls_name="ShardTf", train_uri=SHARD_TRAIN)
 
 
 @scenario(

@@ -367,6 +367,16 @@ class JaxModel(BaseModel):
         per-trial knobs) are excluded."""
         return cls.preprocess is JaxModel.preprocess
 
+    def shard_plan(self, ds: Dataset):
+        """Group-sharding plan for one trial of this template, or None
+        to stay in the single-chip lanes. Families whose train state
+        can outgrow one chip's HBM override this to return a
+        :class:`rafiki_tpu.shard.ShardPlan`; the sweep scheduler routes
+        width>1 plans to a chip group (scheduler/mesh.py GroupHandle,
+        docs/sharding.md). Width-1 plans (and None) mean the serial/
+        packed lanes — the default for every small template."""
+        return None
+
     def packing_key(self, ds: Dataset):
         """Bucket key for the PackedTrialRunner: two models may train
         in one pack iff their keys are equal — same compiled program

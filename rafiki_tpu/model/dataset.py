@@ -174,6 +174,31 @@ def synthetic_corpus(vocab=200, tags=10, n=512, length=24, seed=0, noise=0.05,
     return Dataset(x, y, tags, mask=mask, meta={"kind": "corpus", "synthetic": True, "vocab": vocab})
 
 
+def synthetic_text(vocab=80, classes=5, n=256, length=16, seed=0, noise=0.1,
+                   dist=0) -> Dataset:
+    """Fixed-length token sequences with ONE label per sequence — the
+    text-classification companion to :func:`synthetic_corpus` (which is
+    per-token tagging and therefore carries a mask).
+
+    Token identity encodes the class: token t (1-based) signals class
+    ``(t - 1) % classes``; each sequence draws ``1 - noise`` of its
+    positions from its own class's tokens and the rest uniformly. A
+    mean-pooled embedding separates the classes, accuracy saturates at
+    a noise-determined ceiling, and — crucially for the sharded-trial
+    lane — sequences are fixed-length so ``mask`` is None and the
+    dataset rides the device-resident scan path bit-for-bit.
+    """
+    rng = np.random.default_rng(seed + 1_000_003)
+    y = rng.integers(0, classes, size=n).astype(np.int32)
+    m = max(1, (vocab - 1) // classes)  # class tokens per class
+    sig_tok = 1 + y[:, None] + classes * rng.integers(0, m, size=(n, length))
+    noise_tok = rng.integers(1, vocab, size=(n, length))
+    sig = rng.uniform(size=(n, length)) >= noise
+    x = np.where(sig, sig_tok, noise_tok).astype(np.int32)
+    return Dataset(x, y, classes,
+                   meta={"kind": "text", "synthetic": True, "vocab": vocab})
+
+
 # ---------------------------------------------------------------------------
 # Reference on-disk formats
 # ---------------------------------------------------------------------------
@@ -371,6 +396,12 @@ class DatasetUtils:
                     kw["length"] = kw.pop("len")
                 return synthetic_corpus(**{k: kw[k] for k in kw if k in
                                            ("vocab", "tags", "n", "length", "seed", "noise", "dist")})
+            if parsed.netloc == "text":
+                kw = dict(q)
+                if "len" in kw:
+                    kw["length"] = kw.pop("len")
+                return synthetic_text(**{k: kw[k] for k in kw if k in
+                                         ("vocab", "classes", "n", "length", "seed", "noise", "dist")})
             raise ValueError(f"Unknown synthetic dataset: {parsed.netloc!r}")
         path = _resolve_path(uri)
         if path.endswith(".npz"):

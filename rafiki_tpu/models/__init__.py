@@ -7,6 +7,8 @@ examples/models/ (SURVEY.md §2 "Example models", unverified paths):
   SkDt / SkSvm ← SkDt.py, SkSvm.py (sklearn host models)
   PosBiLstm    ← PyBiLstm.py       (BiLSTM POS tagger)
   PosBigramHmm ← BigramHmm.py      (bigram HMM POS tagger)
+  Transformer  — no reference analog: text-classifier encoder, the
+                 zoo's sharded-lane citizen (docs/sharding.md)
 """
 
 from rafiki_tpu.models.ff import FeedForward
@@ -27,6 +29,7 @@ MODEL_REGISTRY = {
     "SkSvm": ("rafiki_tpu.models.sk", "SkSvm"),
     "PosBiLstm": ("rafiki_tpu.models.pos_bilstm", "PosBiLstm"),
     "PosBigramHmm": ("rafiki_tpu.models.pos_hmm", "PosBigramHmm"),
+    "Transformer": ("rafiki_tpu.models.transformer", "Transformer"),
 }
 
 

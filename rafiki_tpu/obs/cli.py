@@ -687,6 +687,66 @@ def cmd_decisions(log_dir: str, n: int, as_json: bool) -> int:
     return 0
 
 
+def cmd_shard(log_dir: str, as_json: bool) -> int:
+    """The sharded-lane story (docs/sharding.md), reconstructed from
+    the journals alone: per trial, the plan, every group (re-)formation
+    with its width and members, member losses, and reshard-on-restore
+    events — the width history a post-mortem needs. These kinds are
+    write-once forensic state; this is their reader (RF014)."""
+    plans = []
+    by_trial: Dict[str, List[dict]] = {}
+    group_walls = 0
+    for r in journal_mod.read_dir(log_dir):
+        kind, name = r.get("kind"), r.get("name")
+        if kind == "shard" and name == "plan":
+            plans.append(r)
+        elif kind == "shard" and name in ("group_formed", "member_lost",
+                                          "reshard"):
+            by_trial.setdefault(str(r.get("trial_id")), []).append(r)
+        elif (kind == "perf" and name == "step"
+              and int(r.get("group_width") or 0) > 1):
+            group_walls += 1
+    if not plans and not by_trial:
+        print(f"no shard/* records under {log_dir} (did a sharded "
+              f"group run? see docs/sharding.md)", file=sys.stderr)
+        return 1
+    if as_json:
+        for r in plans:
+            print(json.dumps(r, default=str))
+        for rows in by_trial.values():
+            for r in sorted(rows, key=lambda x: x.get("ts", 0.0)):
+                print(json.dumps(r, default=str))
+        return 0
+    for r in plans:
+        frac = r.get("hbm_frac")
+        print(f"plan    family={r.get('family')} width={r.get('width')} "
+              f"hbm_bytes={r.get('hbm_bytes')} "
+              f"hbm_frac={round(frac, 4) if isinstance(frac, float) else frac}")
+    reshards = 0
+    for tid in sorted(by_trial):
+        rows = sorted(by_trial[tid], key=lambda x: x.get("ts", 0.0))
+        widths = [r.get("width") for r in rows
+                  if r.get("name") == "group_formed"]
+        print(f"trial {tid[:13]}  width history: "
+              + (" -> ".join(str(w) for w in widths) or "(none)"))
+        for r in rows:
+            name = r.get("name")
+            if name == "group_formed":
+                line = (f"width={r.get('width')} members={r.get('members')} "
+                        f"attempt={r.get('attempt')}")
+            elif name == "member_lost":
+                line = (f"lost={r.get('lost')} "
+                        f"survivors={r.get('survivors')}")
+            else:
+                reshards += 1
+                line = (f"{r.get('from_width')} -> {r.get('to_width')} "
+                        f"@epoch {r.get('epoch')}")
+            print(f"  {name:<13} {line}")
+    print(f"{len(by_trial)} sharded trial(s), {reshards} reshard "
+          f"restore(s), {group_walls} group epoch wall(s) journaled")
+    return 0
+
+
 def cmd_autoscale(log_dir: str, n: int, as_json: bool, check: bool,
                   window_s: float, max_flips: int) -> int:
     """Replay the controller's decision stream; with ``--check``, gate
@@ -926,6 +986,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "sheds, breaker flips, placement advisories")
     sp.add_argument("-n", type=int, default=32,
                     help="show the last N decisions (0 = all)")
+    sub.add_parser("shard",
+                   help="sharded-group width history: plans, "
+                        "formations, member losses, reshard restores")
     sp = sub.add_parser("autoscale",
                         help="elasticity controller decision replay")
     sp.add_argument("-n", type=int, default=32,
@@ -977,6 +1040,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_serving(log_dir, args.n, args.json)
     if args.cmd == "decisions":
         return cmd_decisions(log_dir, args.n, args.json)
+    if args.cmd == "shard":
+        return cmd_shard(log_dir, args.json)
     if args.cmd == "autoscale":
         return cmd_autoscale(log_dir, args.n, args.json, args.check,
                              args.window, args.flips)

@@ -221,14 +221,19 @@ def capture_cost(key: Any, jitted: Any, *args: Any,
 
 def note_epoch(key: Any, dt: float, feed_s: float = 0.0, cold: bool = False,
                kind: str = "serial", k: int = 1,
-               packing_key: Optional[str] = None) -> Optional[Dict[str, float]]:
+               packing_key: Optional[str] = None,
+               group_width: Optional[int] = None) -> Optional[Dict[str, float]]:
     """Record one epoch's wall split for ``key``; runs the anomaly
     detector on the compute portion and returns its report (already
     journaled / countered / ledgered) when it fires. ``packing_key``
     (the repr of the model's packing key, when the caller is a packed
     loop) is stamped onto the ``perf/step`` record so the train twin's
     step-time calibration buckets per (packing_key, k) without joining
-    through LRU key strings (docs/twin.md)."""
+    through LRU key strings (docs/twin.md). ``group_width`` (set by the
+    sharded loop) likewise rides the record so calibration can keep
+    group-sharded samples out of the single-chip step-time pools — a
+    width-w epoch's wall includes per-step all-gathers and is not a
+    single-chip observation."""
     compute_s = max(dt - feed_s, 0.0)
     with _lock:
         stats = _get(key, kind, k)
@@ -246,7 +251,8 @@ def note_epoch(key: Any, dt: float, feed_s: float = 0.0, cold: bool = False,
     _sample_device_mem()
     journal.record("perf", "step", key_hash=h, dt=dt, feed_s=feed_s,
                    cold=bool(cold), program_kind=kind, k=int(k),
-                   packing_key=packing_key)
+                   packing_key=packing_key,
+                   group_width=int(group_width) if group_width else None)
     if report is not None:
         telemetry.inc("perf.anomalies")
         # The wall this epoch spent over its expected mean bought no

@@ -58,6 +58,13 @@ SCALABLE_SEGMENTS = ("step", "compile")
 #: mild right skew, same philosophy as the serving bundle's grid.
 _NOMINAL_SPREAD = (0.90, 0.94, 0.97, 1.00, 1.00, 1.03, 1.06, 1.10)
 
+#: Bucket-key marker for group-sharded epoch samples (``perf/step``
+#: records stamped with ``group_width`` > 1 by the sharded loop). A
+#: width-w epoch's wall includes per-step all-gathers, so its samples
+#: live under ``<packing_key>@groupw<w>`` and never mix into the
+#: single-chip pools — not even via the unknown-key pooled fallback.
+GROUP_KEY_MARK = "@groupw"
+
 
 class TrainCalibrationError(CalibrationError):
     """A journal dir missing required TRAIN record kinds. ``missing``
@@ -130,6 +137,9 @@ class TrainCalibration:
                 if not pk or not isinstance(dt, (int, float)) or dt < 0:
                     continue
                 step_rows.append(r)
+                gw = int(r.get("group_width") or 0)
+                if gw > 1:
+                    pk = f"{pk}{GROUP_KEY_MARK}{gw}"
                 w = str(int(r.get("k") or 1))
                 dest = compiles if r.get("cold") else steps
                 dest.setdefault(pk, {}).setdefault(w, []).append(float(dt))
@@ -164,6 +174,9 @@ class TrainCalibration:
             packs=packs, sweep=sweep, cost=cost,
             epoch_overhead_s=overhead, source=source,
             meta={"step_records": len(step_rows),
+                  "group_step_records": sum(
+                      1 for r in step_rows
+                      if int(r.get("group_width") or 0) > 1),
                   "pack_records": len(packs),
                   "cost_rows": len(cost)})
 
@@ -285,7 +298,9 @@ class TrainCalibration:
     def _pooled(d: Dict[str, Dict[str, List[float]]]
                 ) -> Dict[str, List[float]]:
         pooled: Dict[str, List[float]] = {}
-        for by_k in d.values():
+        for pk, by_k in d.items():
+            if GROUP_KEY_MARK in pk:
+                continue  # group-sharded walls never model a chip
             for w, xs in by_k.items():
                 pooled.setdefault(w, []).extend(xs)
         return {w: sorted(xs) for w, xs in pooled.items()}

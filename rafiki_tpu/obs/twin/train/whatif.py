@@ -12,6 +12,12 @@ questions a sweep owner should answer BEFORE chips are claimed.
 * :func:`member_forecast` — predicted trials/hour and HBM headroom for
   a PROPOSED zoo member that was never trained: roofline step time
   from its ``perf/cost`` row at an assumed MFU.
+* :func:`group_width_forecast` — the sharded-lane question
+  (docs/sharding.md): one trial of a family run as a width-w chip
+  group, per candidate width — measured group epoch walls where the
+  calibration has ``@groupw`` buckets, per-chip HBM share, and the
+  smallest width that fits (the same solve shard/plan.py performs
+  live, answered from the twin before chips are claimed).
 * :func:`sweep` — a generic config grid (chips/k/n_trials), one
   simulation per combination — the ``obs twin train sweep`` verb.
 
@@ -122,6 +128,58 @@ def member_forecast(cal: TrainCalibration, key_hash_prefix: str,
                               else round(max(0.0, 1.0 - hbm), 4)),
         "fits": hbm is None or hbm <= HBM_CEILING,
     }
+
+
+#: Default group widths group_width_forecast scans.
+DEFAULT_WIDTHS = (1, 2, 4, 8)
+
+
+def group_width_forecast(cal: TrainCalibration, packing_key: str,
+                         widths: Sequence[int] = DEFAULT_WIDTHS,
+                         hbm_bytes: Optional[int] = None,
+                         epochs: Optional[int] = None) -> Dict[str, Any]:
+    """What happens if ONE trial of ``packing_key`` runs as a width-w
+    sharded group, per candidate width: the measured group epoch wall
+    where the calibration holds a ``@groupw`` bucket for that width
+    (group walls are kept out of the single-chip pools, so this is the
+    only place they surface), the per-chip HBM share, and the smallest
+    width that fits under the ceiling — the same solve shard/plan.py
+    performs when the trial is placed for real.
+
+    ``hbm_bytes`` is the trial's whole-state residency estimate
+    (``ShardPlan.hbm_bytes``); absent that the calibration's captured
+    single-chip fraction seeds the share math, and absent THAT the
+    fit column reads unknown-but-permissive (None → fits)."""
+    from rafiki_tpu.obs.twin.calibration import HBM_BYTES_PER_CHIP
+    from rafiki_tpu.obs.twin.train.calibration import GROUP_KEY_MARK
+
+    n_epochs = int(epochs or cal.epochs_for(packing_key))
+    if hbm_bytes:
+        base_frac: Optional[float] = float(hbm_bytes) / HBM_BYTES_PER_CHIP
+    else:
+        base_frac = cal.hbm_frac(k=1)
+    rows = []
+    for w in widths:
+        w = int(w)
+        key = packing_key if w <= 1 else (
+            f"{packing_key}{GROUP_KEY_MARK}{w}")
+        by_k = cal.steps.get(key) or {}
+        xs = sorted(x for samples in by_k.values() for x in samples)
+        epoch_s = xs[len(xs) // 2] if xs else None  # median warm wall
+        frac = None if base_frac is None else base_frac / w
+        trial_s = epoch_s * n_epochs if epoch_s else None
+        rows.append({
+            "width": w,
+            "measured": bool(xs),
+            "epoch_s": round(epoch_s, 9) if epoch_s else None,
+            "trials_per_hour": (round(3600.0 / trial_s, 4)
+                                if trial_s else None),
+            "hbm_frac": None if frac is None else round(frac, 6),
+            "fits": frac is None or frac <= HBM_CEILING,
+        })
+    solved = min((r["width"] for r in rows if r["fits"]), default=None)
+    return {"packing_key": packing_key, "epochs": n_epochs,
+            "rows": rows, "solved_width": solved}
 
 
 def sweep(cal: TrainCalibration, base: TrainTwinConfig,

@@ -22,6 +22,11 @@ vmapped pack (docs/trial_packing.md). Robustness is the headline
     the grace window, the sweep degrades to single-chip mode instead of
     failing: same trials, one chip, and a ``mesh_degraded`` event +
     journal record so the downgrade is reconstructible after the fact.
+  * **Sharded lane** — a proposal whose plan wants ``width > 1`` chips
+    forks onto a :class:`GroupHandle` instead of a pack: one trial
+    sharded FSDP-style across a chip group, member loss handled by
+    re-forming at reduced width and resuming via reshard-on-restore
+    (docs/sharding.md).
 
 The per-chip worker is the ordinary :class:`TrainWorker` — every
 per-trial contract (store rows, scores, feedback, logs, params,
@@ -216,6 +221,191 @@ class _ChipRunner:
                 self.busy = False
                 if kind != "stop":
                     self.tasks.task_done()
+
+
+class GroupHandle:
+    """One chip group running group-sharded trials (docs/sharding.md).
+
+    The sharded-lane analog of a :class:`_ChipRunner`: ``width`` chips
+    form a ``("shard",)`` mesh and train ONE trial at a time via
+    :func:`rafiki_tpu.shard.train_sharded`, checkpointing per-shard
+    chunk manifests every ``RAFIKI_CHECKPOINT_EVERY`` epochs. Member
+    loss — the same ``scheduler.preempt`` chaos probe the supervisor
+    polls for single chips, keyed ``chip<i>`` over this group's member
+    indices — aborts the in-flight trial at its next epoch boundary
+    (that epoch's checkpoint durable FIRST), re-forms the group at
+    reduced width on the survivors, and resumes the trial from its
+    manifest via reshard-on-restore. The group survives while at least
+    one member lives; re-formations journal ``shard/group_formed``
+    again, so the journal stream alone reconstructs the width history.
+    """
+
+    def __init__(self, gi: int, job: dict, sub: dict, model_cls: type,
+                 handle, store: MetaStore, params_store: ParamsStore,
+                 member_indices: List[int], devices: List[Any],
+                 errors: List[str], stop_event: threading.Event):
+        self.gi = gi
+        self.job = job
+        self.sub = sub
+        self.model_cls = model_cls
+        self.handle = handle
+        self.store = store
+        self.params_store = params_store
+        self.members = list(member_indices)
+        self.devices = list(devices)
+        self.rows: List[tuple] = []  # (trial_id, knobs), trained in order
+        self.errors = errors
+        self.stop_event = stop_event
+        self.worker_id = f"{job['id'][:8]}-shard-g{gi}"
+        self.abort = threading.Event()   # member-loss / stop signal
+        self.lost: set = set()           # member indices the probe took
+        self.done = threading.Event()
+        service = store.create_service(
+            ServiceType.TRAIN_WORKER.value, job_id=job["id"],
+            worker_index=self.members[0], devices=[str(d) for d in devices])
+        store.update_service(service["id"],
+                             status=ServiceStatus.RUNNING.value)
+        self.service_id = service["id"]
+        self.thread = threading.Thread(target=self._run,
+                                       name=f"shard-group-{gi}", daemon=True)
+        self._poller = threading.Thread(target=self._poll,
+                                        name=f"shard-group-{gi}-probe",
+                                        daemon=True)
+
+    def start(self) -> None:
+        self.thread.start()
+        self._poller.start()
+
+    def _poll(self) -> None:
+        """Member-loss probe, same site + key scheme as the single-chip
+        supervisor: a ``scheduler.preempt`` kill against any live
+        member flags it lost and trips the group abort (the epoch loop
+        raises GroupAborted AFTER the boundary checkpoint)."""
+        while not self.done.is_set():
+            for i in list(self.members):
+                if i in self.lost:
+                    continue
+                decision = chaos.decide("scheduler.preempt", key=f"chip{i}")
+                if decision is not None and decision.mode in (
+                        "kill", "term", "preempt"):
+                    self.lost.add(i)
+                    self.abort.set()
+            if self.stop_event.is_set():
+                self.abort.set()
+            time.sleep(0.02)
+
+    def _run(self) -> None:
+        try:
+            for tid, kn in self.rows:
+                if self.stop_event.is_set():
+                    return
+                self._run_trial(tid, kn)
+        finally:
+            self.done.set()
+            self.store.update_service(self.service_id,
+                                      status=ServiceStatus.STOPPED.value)
+
+    def _run_trial(self, tid: str, kn: dict) -> None:
+        from rafiki_tpu.shard import GroupAborted, ShardPlan, train_sharded
+
+        job_id = self.job["id"]
+        every = int(os.environ.get("RAFIKI_CHECKPOINT_EVERY", "0"))
+        attempt = 0
+        while True:
+            width = len(self.devices)
+            model = self.model_cls(**kn)
+            self.store.mark_trial_as_running(
+                tid, service_id=self.service_id, worker_id=self.worker_id)
+            plan = ShardPlan(width=width, family=self.model_cls.__name__)
+            plan.note()
+            telemetry.inc("shard.groups_formed")
+            telemetry.set_gauge("shard.group_width", width)
+            _journal.record("shard", "group_formed", job_id=job_id,
+                            trial_id=tid, width=width, members=self.members,
+                            attempt=attempt)
+
+            def sink(epoch: int, loop, _tid=tid) -> None:
+                if every > 0 and (epoch + 1) % every == 0:
+                    t0 = time.monotonic()
+                    try:
+                        from rafiki_tpu.shard import save_sharded
+
+                        save_sharded(self.params_store, _tid, epoch,
+                                     loop.state, loop.width)
+                        events.emit("checkpoint_written", trial_id=_tid,
+                                    epoch=epoch, worker_id=self.worker_id)
+                    except Exception:
+                        # Same contract as the serial sink: a failed
+                        # checkpoint costs resumability, not the trial.
+                        telemetry.inc("worker.checkpoint_write_failed")
+                    finally:
+                        # lint: disable=RF007 — checkpoint_s ledger charge, not a span
+                        ledger.add("checkpoint_s", time.monotonic() - t0,
+                                   entity=f"trial:{_tid}")
+                self.store.update_service(self.service_id, heartbeat=True)
+                # AFTER the write, same ordering as the serial path: a
+                # kill-at-epoch-N fault lands with epoch N durable.
+                chaos.hook("worker.epoch", key=self.worker_id)
+
+            try:
+                train_sharded(model, self.job["train_dataset_uri"],
+                              self.devices, plan=plan, checkpoint_sink=sink,
+                              abort=self.abort,
+                              resume_from=(self.params_store, tid))
+            except GroupAborted:
+                survivors = [i for i in self.members if i not in self.lost]
+                gone = [i for i in self.members if i in self.lost]
+                if self.stop_event.is_set():
+                    return  # stop, not loss: row stays RUNNING
+                telemetry.inc("mesh.chips_lost", max(1, len(gone)))
+                _journal.record("shard", "member_lost", job_id=job_id,
+                                trial_id=tid, lost=gone, survivors=survivors)
+                events.emit("shard_member_lost", job_id=job_id,
+                            trial_id=tid, lost=gone)
+                self.devices = [d for i, d in zip(self.members, self.devices)
+                                if i not in self.lost]
+                self.members = survivors
+                self.abort.clear()
+                if not self.members:
+                    self.store.mark_trial_as_errored(
+                        tid, "sharded group lost every chip")
+                    events.emit("trial_errored", trial_id=tid,
+                                worker_id=self.worker_id,
+                                error="sharded group lost every chip")
+                    return
+                attempt += 1
+                continue  # re-form on the survivors; the resume path
+                # reshards the last durable manifest to the new width.
+            except Exception as e:
+                self.errors.append(f"shard group {self.gi}: {e!r}")
+                self.store.mark_trial_as_errored(tid, repr(e))
+                events.emit("trial_errored", trial_id=tid,
+                            worker_id=self.worker_id, error=repr(e))
+                return
+            # Completion: identical bookkeeping to TrainWorker._persist
+            # (the detached serial loop train_sharded installed makes
+            # evaluate/dump_parameters behave exactly post-serial-train).
+            try:
+                score = float(model.evaluate(self.job["val_dataset_uri"]))
+                blob = model.dump_parameters()
+                params_id = self.params_store.save(blob)
+                self.store.mark_trial_as_completed(tid, score, params_id)
+                self.params_store.delete_checkpoints(tid)  # superseded
+                events.emit("trial_completed", trial_id=tid, score=score,
+                            worker_id=self.worker_id)
+            except Exception as e:
+                self.errors.append(f"shard group {self.gi} persist: {e!r}")
+                self.store.mark_trial_as_errored(
+                    tid, f"params persist failed: {e!r}")
+                events.emit("trial_errored", trial_id=tid,
+                            worker_id=self.worker_id,
+                            error="params persist failed")
+                return
+            try:
+                self.handle.feedback(score, kn)
+            except Exception:
+                pass
+            return
 
 
 class MeshSweepScheduler:
@@ -477,38 +667,7 @@ class MeshSweepScheduler:
             proposals = (batch(n_slots) if batch is not None
                          else [handle.propose() for _ in range(n_slots)])
 
-        # Services + workers, one per chip. Sync persistence: the
-        # supervisor reads row statuses for completion tracking, so
-        # scores must be durable when a pack returns.
         knob_config = model_cls.get_knob_config()
-        # ONE curve coordinator for the whole mesh (None when the
-        # RAFIKI_CURVE_* knobs are off): chips share best-so-far, so a
-        # kill on chip 0 raises the bar for chip 3's stragglers, and a
-        # backfill on any chip can speculate every in-flight trial
-        # fleet-wide (docs/early_kill.md).
-        from rafiki_tpu.advisor.speculative import CurveCoordinator
-        curve = CurveCoordinator.from_env()
-        runners: List[_ChipRunner] = []
-        for i, dev in enumerate(devices):
-            service = self.store.create_service(
-                ServiceType.TRAIN_WORKER.value, job_id=job_id,
-                worker_index=i, devices=[str(dev)])
-            self.store.update_service(service["id"],
-                                      status=ServiceStatus.RUNNING.value)
-            worker = TrainWorker(
-                self.store, self.params_store, sub["id"], model_cls, handle,
-                job["train_dataset_uri"], job["val_dataset_uri"], budget,
-                worker_id=f"{job_id[:8]}-mesh-c{i}", devices=[dev],
-                job_created_at=job["created_at"], service_id=service["id"],
-                stop_event=stop_event, async_persist=False,
-            )
-            # The mid-pack backfill closure claims budget slots from
-            # inside the worker — hand it the WAL so those claims are
-            # intent/commit-bracketed like the up-front ones.
-            worker.wal = self._wal
-            worker.curve = curve
-            runners.append(_ChipRunner(i, dev, worker, k, errors,
-                                       budget_max=budget_max))
 
         # Claim every row up front (atomic budget slots), bucketed by
         # packing key — only same-key rows may share a pack — then
@@ -516,17 +675,35 @@ class MeshSweepScheduler:
         # intent/commit-bracketed: a resumer reconciles these records
         # against the trial rows to prove every budget slot was claimed
         # exactly once (docs/recovery.md).
+        #
+        # Sharded lane fork (docs/sharding.md): a proposal whose
+        # ``shard_plan`` solves a width > 1 doesn't fit one chip — it
+        # buckets under the ``("sharded", family, width)`` key variant
+        # instead of its packing key, and its bucket gets a chip GROUP
+        # (GroupHandle, carved from the tail of the device list) rather
+        # than a k-wide pack slot. Claiming happens BEFORE runner
+        # creation so group devices never host a _ChipRunner.
         wal = self._wal
         buckets: Dict[str, List[tuple]] = {}
         order: List[str] = []
         bucket_epochs: Dict[str, Optional[int]] = {}
+        group_buckets: Dict[int, List[tuple]] = {}  # width -> rows
+        group_order: List[int] = []
         for kn in proposals:
+            width = 1
             try:
                 m = model_cls(**kn)
-                key = repr(m.packing_key(m._prepared_dataset(
-                    job["train_dataset_uri"])))
+                ds = m._prepared_dataset(job["train_dataset_uri"])
+                sp = getattr(m, "shard_plan", None)
+                sp = sp(ds) if callable(sp) else None
+                width = max(1, int(getattr(sp, "width", 1) or 1))
+                if width > 1:
+                    key = repr(("sharded", model_cls.__name__, width))
+                else:
+                    key = repr(m.packing_key(ds))
                 epochs = int(getattr(m, "epochs", 0)) or None
             except Exception:
+                width = 1
                 key = f"unpackable:{id(kn)}"  # its own singleton pack
                 epochs = None
             bucket_epochs.setdefault(key, epochs)
@@ -540,10 +717,77 @@ class MeshSweepScheduler:
                 wal.commit(txn, "budget_claim", denied=True)
                 break  # budget drained under us
             wal.commit(txn, "budget_claim", trial_id=trial["id"])
+            if width > 1:
+                if width not in group_buckets:
+                    group_order.append(width)
+                    group_buckets[width] = []
+                group_buckets[width].append((trial["id"], kn))
+                continue
             if key not in buckets:
                 order.append(key)
                 buckets[key] = []
             buckets[key].append((trial["id"], kn))
+
+        # Carve group devices from the TAIL of the device list so the
+        # packed lane keeps the low indices; one GroupHandle per
+        # distinct width, training its rows sequentially. The width is
+        # clamped to what the mesh can actually give (always leaving
+        # one chip for the packed lane while it has rows).
+        avail = list(devices)
+        reserve = 1 if any(buckets.values()) else 0
+        groups: List[GroupHandle] = []
+        for gi, width in enumerate(group_order):
+            take = min(width, len(avail) - reserve)
+            if take >= 1:
+                member_devs = avail[len(avail) - take:]
+                del avail[len(avail) - take:]
+                member_idx = list(range(len(avail),
+                                        len(avail) + take))
+            else:
+                # Degenerate mesh (packed rows + a group, one device):
+                # share the device at width 1, under a member index
+                # past every real chip so preempt keys never collide.
+                member_devs = [avail[0]]
+                member_idx = [n_chips + gi]
+            g = GroupHandle(gi, job, sub, model_cls, handle, self.store,
+                            self.params_store, member_idx, member_devs,
+                            errors, stop_event)
+            g.rows = group_buckets[width]
+            groups.append(g)
+        n_regular = len(avail)
+
+        # Services + workers, one per (packed-lane) chip. Sync
+        # persistence: the supervisor reads row statuses for completion
+        # tracking, so scores must be durable when a pack returns.
+        # ONE curve coordinator for the whole mesh (None when the
+        # RAFIKI_CURVE_* knobs are off): chips share best-so-far, so a
+        # kill on chip 0 raises the bar for chip 3's stragglers, and a
+        # backfill on any chip can speculate every in-flight trial
+        # fleet-wide (docs/early_kill.md).
+        from rafiki_tpu.advisor.speculative import CurveCoordinator
+        curve = CurveCoordinator.from_env()
+        runners: List[_ChipRunner] = []
+        if any(buckets.values()):
+            for i, dev in enumerate(avail):
+                service = self.store.create_service(
+                    ServiceType.TRAIN_WORKER.value, job_id=job_id,
+                    worker_index=i, devices=[str(dev)])
+                self.store.update_service(service["id"],
+                                          status=ServiceStatus.RUNNING.value)
+                worker = TrainWorker(
+                    self.store, self.params_store, sub["id"], model_cls, handle,
+                    job["train_dataset_uri"], job["val_dataset_uri"], budget,
+                    worker_id=f"{job_id[:8]}-mesh-c{i}", devices=[dev],
+                    job_created_at=job["created_at"], service_id=service["id"],
+                    stop_event=stop_event, async_persist=False,
+                )
+                # The mid-pack backfill closure claims budget slots from
+                # inside the worker — hand it the WAL so those claims are
+                # intent/commit-bracketed like the up-front ones.
+                worker.wal = self._wal
+                worker.curve = curve
+                runners.append(_ChipRunner(i, dev, worker, k, errors,
+                                           budget_max=budget_max))
         assign: List[List[List[tuple]]] = [[[] for _ in order]
                                            for _ in runners]
         # Global round-robin cursor: restarting at chip 0 per bucket
@@ -551,7 +795,7 @@ class MeshSweepScheduler:
         cursor = 0
         for b, key in enumerate(order):
             for row in buckets[key]:
-                assign[cursor % n_chips][b].append(row)
+                assign[cursor % max(1, len(runners))][b].append(row)
                 cursor += 1
         for r, per_bucket in zip(runners, assign):
             for b, rows in enumerate(per_bucket):
@@ -578,12 +822,23 @@ class MeshSweepScheduler:
                         trial_ids=[tid for tid, _kn in rows],
                         knobs_hashes=[_knobs_hash(kn) for _tid, kn in rows])
         _journal.record("mesh", "sweep_started", job_id=job_id,
-                        chips=n_chips, trials_per_chip=k,
-                        n_trials=sum(len(v) for v in buckets.values()))
+                        chips=n_regular, trials_per_chip=k,
+                        n_trials=(sum(len(v) for v in buckets.values())
+                                  + sum(len(v) for v in
+                                        group_buckets.values())),
+                        groups=[{"width": len(g.devices),
+                                 "members": g.members,
+                                 "trials": len(g.rows)} for g in groups]
+                        or None)
+        for g in groups:
+            g.start()
         for r in runners:
             r.thread.start()
 
         chip_seq = [n_chips]  # next chip index for elastic grow
+        # (n_chips counts EVERY formed device, group members included,
+        # so an elastic grow can never mint an index colliding with a
+        # group member's scheduler.preempt key.)
 
         def spawn_chip() -> _ChipRunner:
             """Elastic grow: one more chip joins the live sweep. A
@@ -619,6 +874,24 @@ class MeshSweepScheduler:
 
         self._supervise(job_id, sub["id"], runners, stop_event,
                         elastic=elastic, spawn_chip=spawn_chip)
+
+        # The packed lane has drained (or the sweep was stopped); wait
+        # for the sharded groups. Their member-loss probe runs in each
+        # group's own poller thread, so the only supervision left here
+        # is the liveness lease and the stop signal.
+        hb_s = float(os.environ.get("RAFIKI_SUPERVISOR_HEARTBEAT_S", "5"))
+        last_beat = time.monotonic()
+        for g in groups:
+            while not g.done.wait(timeout=0.05):
+                if stop_event.is_set():
+                    g.abort.set()
+                now = time.monotonic()
+                if (self._sup_service_id
+                        and now - last_beat >= hb_s / 2.0):
+                    last_beat = now
+                    self.store.update_service(self._sup_service_id,
+                                              heartbeat=True)
+            g.thread.join(timeout=30.0)
 
         for r in runners:
             if r.worker._saver is not None:

@@ -1,0 +1,41 @@
+"""Sharded-trial lane: one big model FSDP-sharded across a chip group.
+
+The sweep plane packs many small trials per chip (ops/train.py's
+packed lane); this package is the inverse lane for models whose train
+state outgrows one chip's HBM:
+
+* :mod:`rafiki_tpu.shard.plan` — :class:`ShardPlan`: param pytree +
+  HBM estimate -> smallest group width under the ceiling + per-leaf
+  ``NamedSharding``s over a ``("shard",)`` axis.
+* :mod:`rafiki_tpu.shard.loop` — :class:`ShardedTrainLoop` /
+  :func:`train_sharded`: the group-wide epoch loop, bit-identical to
+  the serial loop at every width.
+* :mod:`rafiki_tpu.shard.checkpoint` — per-shard chunk manifests with
+  **reshard-on-restore**: a width-w checkpoint restores at any width
+  w', which is how a group that loses a chip resumes on its survivors
+  (scheduler/mesh.py's GroupHandle; docs/sharding.md).
+"""
+
+from rafiki_tpu.shard.checkpoint import (gather_state, is_manifest,
+                                         load_manifest, restore_sharded,
+                                         save_sharded)
+from rafiki_tpu.shard.loop import (GroupAborted, ShardedTrainLoop,
+                                   sharded_program_key, train_sharded)
+from rafiki_tpu.shard.plan import (ShardPlan, group_mesh, shard_axis,
+                                   solve_width)
+
+__all__ = [
+    "GroupAborted",
+    "ShardPlan",
+    "ShardedTrainLoop",
+    "gather_state",
+    "group_mesh",
+    "is_manifest",
+    "load_manifest",
+    "restore_sharded",
+    "save_sharded",
+    "shard_axis",
+    "sharded_program_key",
+    "solve_width",
+    "train_sharded",
+]

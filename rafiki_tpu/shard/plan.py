@@ -1,0 +1,225 @@
+"""Group-width planning for sharded trials: param pytree -> NamedShardings.
+
+The sweep's packing lane answers "how many small trials fit one chip";
+this module answers the inverse question — "how many chips does one
+big trial need". A :class:`ShardPlan` turns a model family's param
+pytree (really: any train-state pytree) plus an HBM estimate into
+
+  * the smallest group **width** whose per-chip share of the state
+    fits under the HBM ceiling (``RAFIKI_SHARD_HBM_CEILING`` of the
+    chip's capacity — the same 0.9 the training twin's what-if lane
+    uses), and
+  * per-leaf ``PartitionSpec``s over a 1-D ``("shard",)`` mesh axis:
+    FSDP-style parameter sharding — each leaf is split along its
+    largest width-divisible axis, small/indivisible leaves replicate.
+    The dp batch axis is untouched (batches stay replicated across the
+    group; a dp mesh can still shard them within each member).
+
+The HBM estimate prefers the XLA cost model's ``peak_hbm_bytes`` from
+a ``perf/cost`` capture (obs/perf/profiler.py) when the caller has
+one; absent that it falls back to 4x the raw parameter bytes (params
++ grads + adam mu/nu — the serial loop's steady-state residency).
+
+Placement is *shape-deterministic*: the axis chosen for a leaf is a
+pure function of (shape, width). Reshard-on-restore
+(shard/checkpoint.py) leans on this — a checkpoint written at width w
+records each leaf's saved axis in its manifest, and a restore at
+width w' recomputes its own placement from the same rule, so no
+sharding state needs to survive outside the manifest.
+
+The ``("model",)`` ensemble sketch in parallel/ensemble.py (stacked
+trials, leading trial axis) is the degenerate ancestor of this:
+there the leading axis is *semantic* (trial index); here the axis is
+chosen per-leaf for capacity.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+ENV_HBM_CEILING = "RAFIKI_SHARD_HBM_CEILING"
+ENV_MAX_WIDTH = "RAFIKI_SHARD_MAX_WIDTH"
+ENV_FORCE_WIDTH = "RAFIKI_SHARD_WIDTH"
+
+#: v5e per-chip HBM — single source shared with the twin's capacity math.
+from rafiki_tpu.obs.twin.calibration import HBM_BYTES_PER_CHIP  # noqa: E402
+
+
+def hbm_ceiling() -> float:
+    return float(os.environ.get(ENV_HBM_CEILING, "0.9"))
+
+
+def max_width() -> int:
+    return int(os.environ.get(ENV_MAX_WIDTH, "8"))
+
+
+def forced_width() -> int:
+    """``RAFIKI_SHARD_WIDTH`` > 0 pins the group width (tests, chaos
+    scenarios, and CPU smokes, where no real model trips the ceiling);
+    0 (the default) solves it from the HBM estimate."""
+    return int(os.environ.get(ENV_FORCE_WIDTH, "0"))
+
+
+def shard_axis(shape: Tuple[int, ...], width: int) -> Optional[int]:
+    """The axis of ``shape`` a width-``width`` group shards, or None to
+    replicate. Deterministic: the largest axis whose dim is divisible
+    by (and at least) the width — ties go to the earliest axis."""
+    if width <= 1:
+        return None
+    best = None
+    for a, d in enumerate(shape):
+        if d % width == 0 and d >= width and d > 1:
+            if best is None or d > shape[best]:
+                best = a
+    return best
+
+
+def path_str(path) -> str:
+    """A tree_map_with_path key path rendered to the same ``a/b/c``
+    string flax's flatten_dict(to_state_dict(tree), sep="/") produces —
+    the join key between live pytrees and serialized manifests."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:  # pragma: no cover - future key types
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def state_bytes(tree: Any) -> int:
+    """Raw bytes of every leaf in ``tree`` (shapes only — works on
+    ShapeDtypeStructs from eval_shape as well as live arrays)."""
+    import numpy as np
+
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", ())
+        dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+        total += int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    return total
+
+
+def estimate_hbm_bytes(params: Any,
+                       peak_hbm_bytes: Optional[float] = None) -> int:
+    """HBM residency estimate for one trial: the XLA cost model's
+    figure when a ``perf/cost`` capture exists, else 4x param bytes
+    (params + grads + adam mu/nu)."""
+    if peak_hbm_bytes:
+        return int(peak_hbm_bytes)
+    return 4 * state_bytes(params)
+
+
+def solve_width(hbm_bytes: int, ceiling: Optional[float] = None,
+                cap: Optional[int] = None) -> int:
+    """Smallest power-of-two group width whose per-chip share of
+    ``hbm_bytes`` fits under the ceiling. ``RAFIKI_SHARD_WIDTH``
+    overrides (pinned width); the solve clamps at
+    ``RAFIKI_SHARD_MAX_WIDTH`` even when the estimate wants more."""
+    forced = forced_width()
+    if forced > 0:
+        return forced
+    ceiling = hbm_ceiling() if ceiling is None else ceiling
+    cap = max_width() if cap is None else cap
+    budget = ceiling * HBM_BYTES_PER_CHIP
+    width = 1
+    while width < cap and hbm_bytes / width > budget:
+        width *= 2
+    return width
+
+
+@dataclass(frozen=True)
+class ShardPlan:
+    """One trial's group placement: width + per-leaf partitioning rule.
+
+    Frozen and cheap — a plan is derived data (shapes + an estimate),
+    safe to recompute anywhere; the scheduler journals it once per
+    group as ``shard/plan``.
+    """
+
+    width: int
+    hbm_bytes: int = 0
+    family: str = ""
+
+    @classmethod
+    def for_params(cls, params: Any, family: str = "",
+                   peak_hbm_bytes: Optional[float] = None,
+                   width: Optional[int] = None) -> "ShardPlan":
+        hbm = estimate_hbm_bytes(params, peak_hbm_bytes)
+        return cls(width=width if width else solve_width(hbm),
+                   hbm_bytes=hbm, family=family)
+
+    def hbm_frac(self) -> float:
+        """Estimated per-chip HBM fraction at this plan's width."""
+        if not self.hbm_bytes:
+            return 0.0
+        return self.hbm_bytes / self.width / HBM_BYTES_PER_CHIP
+
+    def axis_of(self, shape: Tuple[int, ...]) -> Optional[int]:
+        return shard_axis(tuple(shape), self.width)
+
+    def spec_of(self, shape: Tuple[int, ...]):
+        from jax.sharding import PartitionSpec as P
+
+        a = self.axis_of(shape)
+        if a is None:
+            return P()
+        return P(*([None] * a + ["shard"]))
+
+    def axes_map(self, tree: Any) -> Dict[str, Optional[int]]:
+        """Flat path -> shard axis (or None) for every leaf of ``tree``
+        (live arrays or ShapeDtypeStructs)."""
+        import jax
+
+        out: Dict[str, Optional[int]] = {}
+
+        def visit(path, leaf):
+            out[path_str(path)] = self.axis_of(getattr(leaf, "shape", ()))
+            return leaf
+
+        jax.tree_util.tree_map_with_path(visit, tree)
+        return out
+
+    def spec_tree(self, tree: Any):
+        """A pytree of PartitionSpecs congruent to ``tree``."""
+        import jax
+
+        return jax.tree_util.tree_map_with_path(
+            lambda p, leaf: self.spec_of(getattr(leaf, "shape", ())), tree)
+
+    def shardings(self, mesh, tree: Any):
+        """A pytree of NamedShardings over ``mesh`` congruent to ``tree``."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                            self.spec_tree(tree),
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def note(self) -> None:
+        """Journal the plan (``shard/plan``) and publish the headroom
+        gauge — the lane's day-one observability contract."""
+        from rafiki_tpu import telemetry
+        from rafiki_tpu.obs.journal import journal
+
+        telemetry.set_gauge("shard.hbm_frac", self.hbm_frac())
+        journal.record("shard", "plan", family=self.family,
+                       width=int(self.width), hbm_bytes=int(self.hbm_bytes),
+                       hbm_frac=self.hbm_frac())
+
+
+def group_mesh(devices):
+    """A 1-D ``("shard",)`` mesh over the group's devices."""
+    import numpy as np
+
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(devices), ("shard",))

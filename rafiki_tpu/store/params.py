@@ -87,10 +87,19 @@ class ParamsStore:
         return self.save(blob, params_id=f"{trial_id}_ckpt_{step}")
 
     def latest_checkpoint(self, trial_id: str) -> Optional[tuple]:
-        """Return (step, blob) of the newest checkpoint for a trial."""
+        """Return (step, blob) of the newest checkpoint for a trial.
+
+        Only ``<trial>_ckpt_<int>`` ids are checkpoint heads; sharded
+        checkpoints park their per-shard chunk blobs in the same
+        namespace with a non-integer suffix (``..._ckpt_3_s0of2``,
+        shard/checkpoint.py) so one ``delete_checkpoints`` sweep
+        reclaims both — those are skipped here, never parsed."""
         best = None
         for p in self._dir.glob(f"{trial_id}_ckpt_*.params"):
-            step = int(p.stem.rsplit("_", 1)[1])
+            suffix = p.stem.rsplit("_", 1)[1]
+            if not suffix.isdigit():
+                continue
+            step = int(suffix)
             if best is None or step > best:
                 best = step
         if best is None:

@@ -123,6 +123,17 @@ RESUME_METRICS = {
     "duplicate_claims": "lower",
 }
 
+#: Sharded-lane rounds (``--shard``): SHARD_r*.json artifacts from
+#: scripts/shard_smoke.py (docs/sharding.md). restore_s is the
+#: reshard-on-restore wall — how long resuming a group trial at a new
+#: width takes; group_trials_per_hour is the lane's throughput
+#: headline. Error rounds (a group that never completed) stamp
+#: ``error`` and yield no data — a dead lane is not a fast one.
+SHARD_METRICS = {
+    "restore_s": "lower",
+    "group_trials_per_hour": "higher",
+}
+
 #: Multi-tenant serving rounds (``--tenants``): TENANT_r*.json
 #: artifacts from ``bench_serving.py --tenants`` (docs/multitenancy.md).
 #: The gold tenant's tail and shed rate are the isolation headline —
@@ -196,7 +207,8 @@ def load_round(path: str) -> Dict[str, Any]:
             or "sweep_schema_version" in doc
             or "scale_schema_version" in doc
             or "store_schema_version" in doc
-            or "resume_schema_version" in doc):
+            or "resume_schema_version" in doc
+            or "shard_schema_version" in doc):
         # A raw bench.py / bench_serving.py result saved directly, no
         # driver wrapper.
         out["payload"], out["source"] = doc, "raw"
@@ -300,6 +312,16 @@ def resume_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     if not isinstance(payload, dict) or payload.get("error"):
         return {}
     return {k: payload.get(k) for k in RESUME_METRICS
+            if payload.get(k) is not None}
+
+
+def shard_headline_of(payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """The sharded-lane block: shard_smoke artifacts carry restore_s
+    and group_trials_per_hour at top level. Error rounds yield nothing
+    — a group that never resumed is no-data, not an instant restore."""
+    if not isinstance(payload, dict) or payload.get("error"):
+        return {}
+    return {k: payload.get(k) for k in SHARD_METRICS
             if payload.get(k) is not None}
 
 
@@ -414,6 +436,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="trend crash-recovery rounds (RESUME_r*.json "
                         "default glob, recovery_wall_s/restarts/duplicate "
                         "claims lower, salvaged trials higher)")
+    p.add_argument("--shard", action="store_true",
+                   help="trend sharded-lane rounds (SHARD_r*.json "
+                        "default glob, reshard restore_s lower, group "
+                        "trials-per-hour higher)")
     p.add_argument("--tenants", action="store_true",
                    help="trend multi-tenant serving rounds "
                         "(TENANT_r*.json default glob, gold tail/shed "
@@ -421,12 +447,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = p.parse_args(argv)
 
     if sum((args.serving, args.twin, args.train_twin, args.sweep,
-            args.scale, args.store, args.resume, args.tenants)) > 1:
+            args.scale, args.store, args.resume, args.tenants,
+            args.shard)) > 1:
         print(json.dumps(
             {"error": "--serving, --twin, --train-twin, --sweep, --scale, "
-                      "--store, --resume and --tenants are exclusive"}))
+                      "--store, --resume, --tenants and --shard are "
+                      "exclusive"}))
         return 2
-    if args.tenants:
+    if args.shard:
+        metric_set, headline_fn = SHARD_METRICS, shard_headline_of
+        pattern = "SHARD_r*.json"
+    elif args.tenants:
         metric_set, headline_fn = TENANT_METRICS, tenant_headline_of
         pattern = "TENANT_r*.json"
     elif args.resume:

@@ -104,6 +104,15 @@ env JAX_PLATFORMS=cpu python scripts/train_twin_smoke.py > /tmp/_train_twin_smok
 # specifically (docs/multitenancy.md). ~20s.
 env JAX_PLATFORMS=cpu python scripts/tenancy_smoke.py > /tmp/_tenancy_smoke.json \
   || { echo "TIER1 TENANCY SMOKE FAILED (see /tmp/_tenancy_smoke.json)"; exit 1; }
+# Sharded-lane smoke: the chip-loss-mid-sharded-trial scenario must
+# PASS with the preempt fault actually fired (width-2 group loses a
+# member, resumes at width 1 via reshard-on-restore, final params
+# bit-match an unfaulted serial run), AND the doctored wrong-width
+# chunk polarity must be REFUSED naming the chunk — a restore that
+# silently accepts mismatched slices is the failure the lane exists
+# to prevent (docs/sharding.md). ~35s.
+env JAX_PLATFORMS=cpu python scripts/shard_smoke.py > /tmp/_shard_smoke.json \
+  || { echo "TIER1 SHARD SMOKE FAILED (see /tmp/_shard_smoke.json)"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Sharded-lane CI smoke: one big trial across a chip group, with a
+mid-trial member loss and a reshard-on-restore resume (docs/sharding.md).
+
+Two polarities, both required for the gate:
+
+  * POSITIVE — the ``chip-loss-mid-sharded-trial`` chaos scenario end
+    to end: a width-2 group loses a member mid-epoch, checkpoints stay
+    durable, the group re-forms at width 1, the restore reshards 2→1,
+    and the finished trial's params bit-match an unfaulted serial run.
+    The preempt fault must ACTUALLY fire — a vacuous pass (nothing
+    injected, nothing recovered) fails the gate.
+  * NEGATIVE — a doctored wrong-width chunk (a width-4 shard spliced
+    into a width-2 manifest) must be REFUSED, naming the chunk. A
+    restore that silently accepts mismatched slices would corrupt
+    params instead of failing loudly.
+
+The lane leg also journals a real plan/save/reshard sequence into a
+tempdir and drives the ``obs shard`` verb over it, so the forensic
+reader is exercised against freshly written records, and times the
+reshard restore for the SHARD_r*.json bench artifact
+(scripts/bench_report.py --shard).
+
+Output: one JSON object on stdout. Exit code: 0 iff the gate holds —
+this is a CI gate (scripts/check_tier1.sh), not just a number printer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCENARIO = "chip-loss-mid-sharded-trial"
+
+
+def _lane_leg(problems: list) -> dict:
+    """A journaled plan/train/save/reshard round plus the doctored
+    wrong-width refusal, in-process on the virtual pod."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from rafiki_tpu.obs.journal import journal
+    from rafiki_tpu.shard import (ShardPlan, ShardedTrainLoop, gather_state,
+                                  restore_sharded, save_sharded)
+    from rafiki_tpu.store.params import ParamsStore
+
+    import flax.linen as nn
+    import optax
+
+    class Mlp(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(nn.relu(nn.Dense(16)(x)))
+
+    m = Mlp()
+
+    def init_fn(rng):
+        return m.init(rng, jnp.zeros((1, 8), jnp.float32))
+
+    def apply_fn(p, x):
+        return m.apply(p, x)
+
+    def loss_fn(p, batch, rng=None):
+        logits = apply_fn(p, batch["x"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+        return loss, {"acc": (logits.argmax(-1) == batch["y"]).mean()}
+
+    class _Ds:
+        def __init__(self):
+            rng = np.random.default_rng(0)
+            self.x = rng.normal(size=(64, 8)).astype(np.float32)
+            self.y = rng.integers(0, 4, size=(64,)).astype(np.int32)
+            self.size = 64
+            self.mask = None
+
+    ds = _Ds()
+    devs = jax.devices()
+    epochs = 2
+    prev = (journal.log_dir if journal.configured else None, journal.role)
+    with tempfile.TemporaryDirectory() as d:
+        journal.configure(d, role="shard-smoke")
+        try:
+            loops = {}
+            t_train = time.monotonic()
+            for w in (2, 4):
+                plan = ShardPlan(width=w, family="mlp")
+                plan.note()
+                loop = ShardedTrainLoop(
+                    init_fn, apply_fn, loss_fn, devices=devs[:w], seed=3,
+                    plan=plan, program_key=("shard_smoke", "mlp"))
+                for ep in range(epochs):
+                    loop.run_epoch(ds, 8, epoch_seed=3 + ep)
+                loops[w] = loop
+            # lint: disable=RF007 — smoke artifact wall-clock
+            trial_s = (time.monotonic() - t_train) / 2
+
+            store = ParamsStore(os.path.join(d, "params"))
+            save_sharded(store, "a", epochs - 1, loops[2].state, 2)
+            save_sharded(store, "b", epochs - 1, loops[4].state, 4)
+
+            # reshard 2→4, timed — the recovery headline
+            _ep, blob = store.latest_checkpoint("a")
+            t0 = time.monotonic()
+            restored = restore_sharded(store, blob, loops[4].state,
+                                       loops[4].mesh, loops[4].plan)
+            # lint: disable=RF007 — smoke artifact wall-clock
+            restore_s = time.monotonic() - t0
+            la = jax.tree_util.tree_leaves(gather_state(restored))
+            lb = jax.tree_util.tree_leaves(gather_state(loops[2].state))
+            bitmatch = len(la) == len(lb) and all(
+                np.asarray(x).dtype == np.asarray(y).dtype
+                and np.array_equal(np.asarray(x), np.asarray(y))
+                for x, y in zip(la, lb))
+            if not bitmatch:
+                problems.append("reshard 2->4 did not bit-match the source")
+
+            # NEGATIVE polarity: splice a width-4 chunk into the
+            # width-2 manifest — the restore must refuse, naming it.
+            man = json.loads(blob.decode())
+            bad_chunk = f"b_ckpt_{epochs - 1}_s0of4"
+            man["shards"][0] = bad_chunk
+            caught = False
+            try:
+                restore_sharded(store, json.dumps(man).encode(),
+                                loops[2].state, loops[2].mesh, loops[2].plan)
+            except IOError as e:
+                caught = bad_chunk in str(e)
+            if not caught:
+                problems.append(
+                    "doctored wrong-width chunk was NOT refused by name")
+
+            # drive the forensic reader over the fresh records
+            from rafiki_tpu.obs.cli import cmd_shard
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                obs_rc = cmd_shard(d, as_json=True)
+            obs_rows = [json.loads(ln) for ln in buf.getvalue().splitlines()
+                        if ln.strip()]
+            if obs_rc != 0 or not obs_rows:
+                problems.append("obs shard saw no records in a journaled "
+                                "lane run")
+            return {
+                "restore_s": round(restore_s, 4),
+                "group_trials_per_hour": round(3600.0 / (trial_s * 1.0), 2),
+                "reshard_bitmatch": bitmatch,
+                "wrong_width_refused": caught,
+                "obs_shard_rows": len(obs_rows),
+            }
+        finally:
+            if prev[0] is not None:
+                journal.configure(prev[0], role=prev[1])
+            else:
+                journal.close()
+
+
+def main() -> int:
+    # Platform pin BEFORE jax loads; then fake a multi-chip pod on the
+    # host platform (same 8-virtual-device shape as the test suite).
+    from rafiki_tpu.utils.backend import (ensure_host_device_count,
+                                          honor_env_platform)
+
+    honor_env_platform()
+    ensure_host_device_count(8)
+
+    from rafiki_tpu.chaos.runner import format_report, run_scenario
+
+    problems: list = []
+    t0 = time.monotonic()
+    report = run_scenario(SCENARIO)
+    injected = [s for s in report.schedule if s[0] == "scheduler.preempt"]
+    if not report.passed:
+        problems.append("scenario invariants violated")
+    if not injected:
+        problems.append("no scheduler.preempt fault fired (vacuous pass)")
+
+    lane = _lane_leg(problems)
+    out = {
+        "scenario": SCENARIO,
+        "passed": report.passed,
+        "member_loss_injected": len(injected),
+        **lane,
+        # lint: disable=RF007 — smoke artifact wall-clock
+        "wall_s": round(time.monotonic() - t0, 2),
+        "report": report.to_dict(),
+    }
+    if problems:
+        out["problems"] = problems
+    print(json.dumps(out, indent=2))
+    if problems:
+        print(format_report(report), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,7 +31,7 @@ def test_all_builtin_checkers_registered():
     assert {"RF001", "RF002", "RF003", "RF004", "RF005", "RF006",
             "RF007", "RF008", "RF009", "RF010", "RF011",
             "RF012", "RF013", "RF014", "RF015", "RF016",
-            "RF017", "RF018"} <= set(REGISTRY)
+            "RF017", "RF018", "RF019"} <= set(REGISTRY)
 
 
 # ---------------------------------------------------------------------------
@@ -1407,4 +1407,88 @@ def test_rf018_justified_suppression_honored(tmp_path):
 def test_rf018_current_tree_is_clean():
     r = analyze_paths([os.path.join(REPO, "rafiki_tpu")], select=["RF018"])
     mine = [f for f in r.unsuppressed if f.checker_id == "RF018"]
+    assert mine == [], [f"{f.path}:{f.line}" for f in mine]
+
+
+# ---------------------------------------------------------------------------
+# RF019 full-gather-hazard
+# ---------------------------------------------------------------------------
+
+
+RF019_BAD_GATHER = """
+    import jax
+    import numpy as np
+    from rafiki_tpu.shard import ShardedTrainLoop, train_sharded
+
+    def snapshot(model, uri, devices):
+        loop, history = train_sharded(model, uri, devices)
+        host = jax.device_get(loop.state)
+        return np.asarray(host), history
+
+    def peek(init_fn, apply_fn, loss_fn, devices):
+        loop = ShardedTrainLoop(init_fn, apply_fn, loss_fn,
+                                devices=devices)
+        st = loop.state
+        return np.asarray(st)
+    """
+
+
+def test_rf019_fires_on_full_gather_of_group_state(tmp_path):
+    r = _analyze_snippet(tmp_path, RF019_BAD_GATHER, select=["RF019"])
+    found = [f for f in r.unsuppressed if f.checker_id == "RF019"]
+    # device_get(loop.state), np.asarray(host)... host is not tracked
+    # (one-hop chains only) — device_get + np.asarray(st) = 2 sites
+    assert len(found) == 2
+    assert all(f.severity == "error" for f in found)
+    assert "gather_state" in found[0].message
+
+
+def test_rf019_quiet_on_sanctioned_paths(tmp_path):
+    # save_sharded of loop.state and gather_state are THE manifest
+    # path; device_get of anything untainted is ordinary jax.
+    r = _analyze_snippet(tmp_path, """
+        import jax
+        from rafiki_tpu.shard import (gather_state, save_sharded,
+                                      train_sharded)
+
+        def checkpoint(store, tid, model, uri, devices):
+            loop, _hist = train_sharded(model, uri, devices)
+            save_sharded(store, tid, 0, loop.state, loop.width)
+            return gather_state(loop.state)
+
+        def other(x):
+            return jax.device_get(x)
+        """, select=["RF019"])
+    assert "RF019" not in _ids(r)
+
+
+def test_rf019_exempts_the_checkpoint_module_itself(tmp_path):
+    shard = tmp_path / "rafiki_tpu" / "shard"
+    shard.mkdir(parents=True)
+    for d in (tmp_path / "rafiki_tpu", shard):
+        (d / "__init__.py").write_text("")
+    f = shard / "checkpoint.py"
+    f.write_text(textwrap.dedent(RF019_BAD_GATHER))
+    r = analyze_paths([str(f)], select=["RF019"])
+    assert "RF019" not in _ids(r)
+
+
+def test_rf019_justified_suppression_honored(tmp_path):
+    r = _analyze_snippet(tmp_path, """
+        import numpy as np
+        from rafiki_tpu.shard import train_sharded
+
+        def debug_norms(model, uri, devices):
+            loop, _h = train_sharded(model, uri, devices)
+            # lint: disable=RF019 — scalar leaf norms only, bounded copy
+            return np.asarray(loop.state)
+        """, select=["RF019"])
+    assert "RF019" not in _ids(r)
+
+
+def test_rf019_current_tree_is_clean():
+    r = analyze_paths([os.path.join(REPO, "rafiki_tpu"),
+                       os.path.join(REPO, "bench.py"),
+                       os.path.join(REPO, "scripts")], select=["RF019"])
+    mine = [f for f in r.unsuppressed if f.checker_id == "RF019"]
     assert mine == [], [f"{f.path}:{f.line}" for f in mine]

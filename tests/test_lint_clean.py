@@ -42,6 +42,16 @@ def test_repo_clean_under_contract_checkers():
     assert pretty == [], "\n".join(pretty)
 
 
+def test_repo_clean_under_full_gather_checker():
+    """RF019 specifically (docs/sharding.md): group-sharded train
+    state is materialized on a host ONLY through shard/checkpoint.py's
+    manifest path (save_sharded / gather_state)."""
+    result = analyze_paths(LINT_PATHS, select=["RF019"])
+    pretty = [f"{f.location()} {f.checker_id}: {f.message}"
+              for f in result.unsuppressed]
+    assert pretty == [], "\n".join(pretty)
+
+
 def test_contracts_manifest_golden_matches_tree():
     """The committed manifest is byte-identical to a fresh extraction —
     the in-process form of check_lint.sh's contracts diff. On drift:

@@ -73,6 +73,47 @@ def test_pos_bilstm_contract():
     assert len(preds[0]) == 3 and len(preds[1]) == 2
 
 
+def test_transformer_contract():
+    from rafiki_tpu.models.transformer import Transformer
+
+    score, preds = test_model_class(
+        Transformer, "TEXT_CLASSIFICATION",
+        "synthetic://text?vocab=81&classes=5&n=512&len=16&seed=0",
+        "synthetic://text?vocab=81&classes=5&n=128&len=16&seed=1",
+        queries=[[5, 9, 3] * 5 + [1], [17, 2] * 8],
+        knobs=dict(embed_dim=32, num_heads=2, num_layers=1,
+                   learning_rate=5e-3, batch_size=32, epochs=3, seed=0))
+    assert score > 0.5  # the signal token is learnable
+    assert len(preds[0]) == 5  # one distribution over the 5 classes
+
+
+def test_transformer_declares_a_shard_plan():
+    # The zoo's sharded-lane citizen: its plan must solve (width 1 on
+    # this small config without the pin) and honor the env pin — the
+    # exact decision point the scheduler's lane fork reads.
+    import os
+
+    from rafiki_tpu.models.transformer import Transformer
+    from rafiki_tpu.shard import ShardPlan
+
+    m = Transformer(embed_dim=32, num_heads=2, num_layers=1,
+                    learning_rate=5e-3, batch_size=32, epochs=1, seed=0)
+    ds = m._prepared_dataset(
+        "synthetic://text?vocab=81&classes=5&n=64&len=16&seed=0")
+    prev = os.environ.pop("RAFIKI_SHARD_WIDTH", None)
+    try:
+        plan = m.shard_plan(ds)
+        assert isinstance(plan, ShardPlan)
+        assert plan.width == 1 and plan.hbm_bytes > 0
+        os.environ["RAFIKI_SHARD_WIDTH"] = "2"
+        assert m.shard_plan(ds).width == 2
+    finally:
+        if prev is None:
+            os.environ.pop("RAFIKI_SHARD_WIDTH", None)
+        else:
+            os.environ["RAFIKI_SHARD_WIDTH"] = prev
+
+
 def test_pos_hmm_contract():
     from rafiki_tpu.models.pos_hmm import PosBigramHmm
 
